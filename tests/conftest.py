@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Forces JAX onto an 8-device virtual CPU mesh so multi-chip sharding paths can
+be exercised without TPU hardware, and enables panic-on-assert so resource
+accounting violations fail tests loudly.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["VOLCANO_TPU_PANIC"] = "1"

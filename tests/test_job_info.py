@@ -1,0 +1,104 @@
+"""JobInfo/TaskInfo tests (mirrors pkg/scheduler/api/job_info_test.go)."""
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.job_info import JobInfo, get_job_id, new_task_info
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.scheduler.util.test_utils import build_pod, build_resource_list
+
+
+def make_task(name, phase=objects.POD_PHASE_PENDING, node="", cpu="1000m", group="pg1"):
+    pod = build_pod("ns1", name, node, phase, build_resource_list(cpu, "1Gi"), group)
+    return new_task_info(pod)
+
+
+class TestTaskInfo:
+    def test_status_mapping(self):
+        assert make_task("a").status == TaskStatus.PENDING
+        assert make_task("b", node="n1").status == TaskStatus.BOUND
+        assert (
+            make_task("c", phase=objects.POD_PHASE_RUNNING, node="n1").status
+            == TaskStatus.RUNNING
+        )
+        assert make_task("d", phase=objects.POD_PHASE_SUCCEEDED).status == TaskStatus.SUCCEEDED
+        assert make_task("e", phase=objects.POD_PHASE_FAILED).status == TaskStatus.FAILED
+
+    def test_releasing_on_deletion(self):
+        pod = build_pod("ns1", "x", "n1", objects.POD_PHASE_RUNNING,
+                        build_resource_list("1", "1Gi"), "pg1")
+        pod.metadata.deletion_timestamp = 123.0
+        assert new_task_info(pod).status == TaskStatus.RELEASING
+
+    def test_job_id(self):
+        pod = build_pod("ns1", "x", "", objects.POD_PHASE_PENDING,
+                        build_resource_list("1", "1Gi"), "pg1")
+        assert get_job_id(pod) == "ns1/pg1"
+        pod2 = build_pod("ns1", "y", "", objects.POD_PHASE_PENDING,
+                         build_resource_list("1", "1Gi"))
+        assert get_job_id(pod2) == ""
+
+    def test_init_resreq_max(self):
+        pod = build_pod("ns1", "x", "", objects.POD_PHASE_PENDING,
+                        build_resource_list("2", "1Gi"), "pg1")
+        pod.spec.init_containers = [
+            objects.Container(name="init", requests=build_resource_list("4", "512Mi"))
+        ]
+        ti = new_task_info(pod)
+        assert ti.resreq.milli_cpu == 2000
+        assert ti.init_resreq.milli_cpu == 4000
+        assert ti.init_resreq.memory == 2**30  # main containers' sum wins
+
+
+class TestJobInfo:
+    def test_add_task(self):
+        job = JobInfo("ns1/pg1", make_task("t1"), make_task("t2", node="n1"))
+        assert len(job.tasks) == 2
+        assert job.total_request.milli_cpu == 2000
+        # bound task counts as allocated
+        assert job.allocated.milli_cpu == 1000
+        assert len(job.task_status_index[TaskStatus.PENDING]) == 1
+        assert len(job.task_status_index[TaskStatus.BOUND]) == 1
+
+    def test_delete_task(self):
+        t1, t2 = make_task("t1"), make_task("t2", node="n1")
+        job = JobInfo("ns1/pg1", t1, t2)
+        job.delete_task_info(t2)
+        assert job.allocated.milli_cpu == 0
+        assert job.total_request.milli_cpu == 1000
+        assert TaskStatus.BOUND not in job.task_status_index
+
+    def test_update_task_status(self):
+        t1 = make_task("t1")
+        job = JobInfo("ns1/pg1", t1)
+        job.update_task_status(t1, TaskStatus.ALLOCATED)
+        assert job.allocated.milli_cpu == 1000
+        assert job.ready_task_num() == 1
+        job.update_task_status(t1, TaskStatus.PENDING)
+        assert job.allocated.milli_cpu == 0
+
+    def test_readiness(self):
+        tasks = [make_task(f"t{i}") for i in range(4)]
+        job = JobInfo("ns1/pg1", *tasks)
+        job.min_available = 3
+        assert not job.ready()
+        for t in tasks[:2]:
+            job.update_task_status(t, TaskStatus.ALLOCATED)
+        assert not job.ready()
+        job.update_task_status(tasks[2], TaskStatus.PIPELINED)
+        assert not job.ready()
+        assert job.pipelined()  # 2 ready + 1 pipelined >= 3
+        job.update_task_status(tasks[3], TaskStatus.ALLOCATED)
+        assert job.ready()
+
+    def test_valid_task_num(self):
+        tasks = [make_task(f"t{i}") for i in range(3)]
+        job = JobInfo("ns1/pg1", *tasks)
+        job.update_task_status(tasks[0], TaskStatus.FAILED)
+        assert job.valid_task_num() == 2
+
+    def test_clone_independent(self):
+        t1 = make_task("t1")
+        job = JobInfo("ns1/pg1", t1)
+        clone = job.clone()
+        clone.update_task_status(clone.tasks[t1.uid], TaskStatus.ALLOCATED)
+        assert job.allocated.milli_cpu == 0
+        assert clone.allocated.milli_cpu == 1000

@@ -1,0 +1,99 @@
+"""NodeInfo accounting tests (mirrors pkg/scheduler/api/node_info_test.go)."""
+
+import pytest
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.job_info import new_task_info
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.types import NodePhase, TaskStatus
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_pod,
+    build_resource_list,
+)
+
+
+def task(name, cpu="1000m", status_phase=objects.POD_PHASE_RUNNING, node="n1"):
+    pod = build_pod("ns1", name, node, status_phase,
+                    build_resource_list(cpu, "1Gi"), "pg1")
+    return new_task_info(pod)
+
+
+class TestNodeInfo:
+    def test_add_remove(self):
+        ni = NodeInfo(build_node("n1", build_resource_list("8", "16Gi")))
+        assert ni.ready()
+        t1 = task("t1", "2000m")
+        ni.add_task(t1)
+        assert ni.idle.milli_cpu == 6000
+        assert ni.used.milli_cpu == 2000
+        ni.remove_task(t1)
+        assert ni.idle.milli_cpu == 8000
+        assert ni.used.milli_cpu == 0
+
+    def test_clone_holds_copies(self):
+        ni = NodeInfo(build_node("n1", build_resource_list("8", "16Gi")))
+        t1 = task("t1", "2000m")
+        ni.add_task(t1)
+        # mutating the original task's status must not affect node accounting
+        t1.status = TaskStatus.SUCCEEDED
+        ni.remove_task(t1)  # looked up by key; uses held clone's status
+        assert ni.idle.milli_cpu == 8000
+
+    def test_releasing(self):
+        ni = NodeInfo(build_node("n1", build_resource_list("8", "16Gi")))
+        pod = build_pod("ns1", "t1", "n1", objects.POD_PHASE_RUNNING,
+                        build_resource_list("2", "1Gi"), "pg1")
+        pod.metadata.deletion_timestamp = 1.0
+        ti = new_task_info(pod)
+        assert ti.status == TaskStatus.RELEASING
+        ni.add_task(ti)
+        assert ni.releasing.milli_cpu == 2000
+        assert ni.idle.milli_cpu == 6000
+        ni.remove_task(ti)
+        assert ni.releasing.milli_cpu == 0
+        assert ni.idle.milli_cpu == 8000
+
+    def test_pipelined_consumes_releasing(self):
+        ni = NodeInfo(build_node("n1", build_resource_list("8", "16Gi")))
+        rel = task("rel", "4000m")
+        rel.status = TaskStatus.RELEASING
+        ni.add_task(rel)
+        assert ni.releasing.milli_cpu == 4000
+        pip = task("pip", "3000m")
+        pip.status = TaskStatus.PIPELINED
+        ni.add_task(pip)
+        # pipelined task eats into releasing, not idle
+        assert ni.releasing.milli_cpu == 1000
+        assert ni.idle.milli_cpu == 4000
+        assert ni.used.milli_cpu == 7000
+
+    def test_out_of_sync_on_overalloc(self):
+        ni = NodeInfo(build_node("n1", build_resource_list("2", "4Gi")))
+        with pytest.raises(RuntimeError):
+            ni.add_task(task("big", "4000m"))
+        assert not ni.ready()
+        assert ni.state.reason == "OutOfSync"
+
+    def test_duplicate_add_rejected(self):
+        ni = NodeInfo(build_node("n1", build_resource_list("8", "16Gi")))
+        ni.add_task(task("t1"))
+        with pytest.raises(RuntimeError):
+            ni.add_task(task("t1"))
+
+    def test_not_ready_node(self):
+        n = build_node("n1", build_resource_list("8", "16Gi"))
+        n.status.conditions = [objects.NodeCondition(type="Ready", status="False")]
+        ni = NodeInfo(n)
+        assert not ni.ready()
+        assert ni.state.phase == NodePhase.NOT_READY
+
+    def test_set_node_recomputes(self):
+        small = build_node("n1", build_resource_list("4", "8Gi"))
+        ni = NodeInfo(small)
+        ni.add_task(task("t1", "2000m"))
+        bigger = build_node("n1", build_resource_list("16", "32Gi"))
+        ni.set_node(bigger)
+        assert ni.allocatable.milli_cpu == 16000
+        assert ni.idle.milli_cpu == 14000
+        assert ni.used.milli_cpu == 2000

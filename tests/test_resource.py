@@ -1,0 +1,162 @@
+"""Resource arithmetic parity tests (mirrors pkg/scheduler/api/resource_info_test.go)."""
+
+import pytest
+
+from volcano_tpu.api.quantity import milli_value, parse_quantity
+from volcano_tpu.api.resource import (
+    MIN_MEMORY,
+    MIN_MILLI_CPU,
+    MIN_MILLI_SCALAR,
+    Resource,
+)
+from volcano_tpu.utils.assertions import AssertionViolation
+
+
+def res(mcpu=0.0, mem=0.0, scalars=None):
+    return Resource(mcpu, mem, dict(scalars) if scalars else None)
+
+
+class TestQuantity:
+    def test_plain(self):
+        assert parse_quantity("2") == 2.0
+        assert parse_quantity(1.5) == 1.5
+        assert parse_quantity("1e3") == 1000.0
+
+    def test_milli(self):
+        assert parse_quantity("500m") == 0.5
+        assert milli_value("500m") == 500.0
+        assert milli_value("2") == 2000.0
+
+    def test_binary(self):
+        assert parse_quantity("1Ki") == 1024
+        assert parse_quantity("8Gi") == 8 * 2**30
+        assert parse_quantity("1.5Mi") == 1.5 * 2**20
+
+    def test_decimal_suffix(self):
+        assert parse_quantity("2k") == 2000
+        assert parse_quantity("1G") == 10**9
+
+
+class TestFromResourceList:
+    def test_basic(self):
+        r = Resource.from_resource_list(
+            {"cpu": "4", "memory": "8Gi", "pods": 110, "nvidia.com/gpu": 2}
+        )
+        assert r.milli_cpu == 4000
+        assert r.memory == 8 * 2**30
+        assert r.max_task_num == 110
+        assert r.scalar_resources == {"nvidia.com/gpu": 2000.0}
+
+    def test_ignores_unknown_native(self):
+        r = Resource.from_resource_list({"ephemeral-storage": "10Gi"})
+        assert r.is_empty()
+
+
+class TestComparisons:
+    def test_less_equal_epsilon_cpu(self):
+        # within epsilon counts as equal (resource_info.go:267-275)
+        assert res(mcpu=1009).less_equal(res(mcpu=1000))
+        assert not res(mcpu=1011).less_equal(res(mcpu=1000))
+
+    def test_less_equal_epsilon_memory(self):
+        assert res(mem=MIN_MEMORY - 1).less_equal(res(mem=0))
+        assert not res(mem=MIN_MEMORY + 1).less_equal(res(mem=0))
+
+    def test_less_equal_scalar_below_min_ignored(self):
+        # scalar dims at or below the min are skipped entirely
+        assert res(scalars={"nvidia.com/gpu": MIN_MILLI_SCALAR}).less_equal(res())
+        assert not res(scalars={"nvidia.com/gpu": 1000}).less_equal(res())
+
+    def test_less_equal_scalar_against_nil(self):
+        # rr has no scalar map but we need >min scalar: not fitting
+        assert not res(scalars={"x/y": 100}).less_equal(res(mcpu=10000, mem=1e12))
+
+    def test_less_strict(self):
+        assert res(mcpu=1, mem=1).less(res(mcpu=2, mem=2))
+        assert not res(mcpu=2, mem=1).less(res(mcpu=2, mem=2))
+
+    def test_less_nil_scalars_lhs(self):
+        # lhs nil scalars: rr scalar <= min makes it non-less (go semantics)
+        assert not res(mcpu=1, mem=1).less(
+            res(mcpu=2, mem=2, scalars={"a/b": MIN_MILLI_SCALAR})
+        )
+        assert res(mcpu=1, mem=1).less(res(mcpu=2, mem=2, scalars={"a/b": 100}))
+
+    def test_less_nil_scalars_rhs(self):
+        assert not res(mcpu=1, mem=1, scalars={"a/b": 5}).less(res(mcpu=2, mem=2))
+
+
+class TestArithmetic:
+    def test_add(self):
+        r = res(mcpu=1000, mem=100)
+        r.add(res(mcpu=500, mem=50, scalars={"nvidia.com/gpu": 1000}))
+        assert r.milli_cpu == 1500
+        assert r.memory == 150
+        assert r.scalar_resources["nvidia.com/gpu"] == 1000
+
+    def test_sub(self):
+        r = res(mcpu=1000, mem=1e9, scalars={"nvidia.com/gpu": 2000})
+        r.sub(res(mcpu=400, mem=2e8, scalars={"nvidia.com/gpu": 1000}))
+        assert r.milli_cpu == 600
+        assert r.memory == 8e8
+        assert r.scalar_resources["nvidia.com/gpu"] == 1000
+
+    def test_sub_insufficient_panics(self):
+        with pytest.raises(AssertionViolation):
+            res(mcpu=100).sub(res(mcpu=500))
+
+    def test_sub_within_epsilon_allowed(self):
+        # epsilon tolerance lets slightly-over subtraction through; result
+        # may go slightly negative, matching the reference
+        r = res(mcpu=1000)
+        r.sub(res(mcpu=1005))
+        assert r.milli_cpu == -5
+
+    def test_multi(self):
+        r = res(mcpu=1000, mem=100, scalars={"a/b": 10})
+        r.multi(1.2)
+        assert r.milli_cpu == 1200
+        assert abs(r.memory - 120) < 1e-9
+        assert r.scalar_resources["a/b"] == 12
+
+    def test_set_max_resource(self):
+        r = res(mcpu=1000, mem=100)
+        r.set_max_resource(res(mcpu=500, mem=200, scalars={"a/b": 7}))
+        assert r.milli_cpu == 1000
+        assert r.memory == 200
+        assert r.scalar_resources == {"a/b": 7}
+
+    def test_fit_delta(self):
+        r = res(mcpu=1000, mem=MIN_MEMORY * 3)
+        r.fit_delta(res(mcpu=500, mem=MIN_MEMORY))
+        assert r.milli_cpu == 1000 - 500 - MIN_MILLI_CPU
+        assert r.memory == MIN_MEMORY * 3 - MIN_MEMORY - MIN_MEMORY
+
+    def test_diff(self):
+        inc, dec = res(mcpu=1000, mem=50).diff(res(mcpu=400, mem=100))
+        assert inc.milli_cpu == 600 and inc.memory == 0
+        assert dec.milli_cpu == 0 and dec.memory == 50
+
+    def test_clone_independent(self):
+        r = res(mcpu=1, scalars={"a/b": 1})
+        c = r.clone()
+        c.add(res(mcpu=5, scalars={"a/b": 5}))
+        assert r.milli_cpu == 1
+        assert r.scalar_resources["a/b"] == 1
+
+
+class TestEmptyZero:
+    def test_is_empty(self):
+        assert Resource.empty().is_empty()
+        assert res(mcpu=MIN_MILLI_CPU - 1, mem=MIN_MEMORY - 1).is_empty()
+        assert not res(mcpu=MIN_MILLI_CPU).is_empty()
+        assert not res(scalars={"a/b": MIN_MILLI_SCALAR}).is_empty()
+
+    def test_is_zero(self):
+        assert res(mcpu=5).is_zero("cpu")
+        assert not res(mcpu=50).is_zero("cpu")
+        assert res().is_zero("some/scalar")  # nil map => zero
+
+    def test_is_zero_unknown_scalar_panics(self):
+        with pytest.raises(AssertionViolation):
+            res(scalars={"a/b": 5}).is_zero("c/d")

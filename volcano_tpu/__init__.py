@@ -1,0 +1,17 @@
+"""volcano-tpu: a TPU-native gang-scheduling batch framework.
+
+A brand-new framework with the capabilities of Volcano (the Kubernetes batch
+scheduler): PodGroup/Queue/Job APIs, a session-based scheduler with pluggable
+actions (enqueue/allocate/backfill/preempt/reclaim) and policy plugins (gang,
+DRF, proportion, priority, predicates, nodeorder, binpack, conformance), a
+job-lifecycle controller manager, admission, and a CLI.
+
+The control plane keeps the session/plugin architecture; the per-session
+placement solve — predicate masks x node scores x gang feasibility x
+fair-share over (tasks x nodes) — is a batched JAX/XLA constraint solve
+sharded across TPU chips (see volcano_tpu.ops and volcano_tpu.parallel),
+behind the plugin API so the serial loop remains as fallback and parity
+oracle.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,270 @@
+"""TaskInfo and JobInfo — the session's working view of pods and pod groups
+(volcano pkg/scheduler/api/job_info.go)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.objects import GROUP_NAME_ANNOTATION_KEY
+from volcano_tpu.api.pod_helpers import (
+    get_pod_resource_request,
+    get_pod_resource_without_init_containers,
+    get_task_status,
+)
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import TaskStatus, allocated_status
+from volcano_tpu.api.unschedule_info import FitErrors
+
+
+def get_job_id(pod: objects.Pod) -> str:
+    """Job key of a pod via its group-name annotation (job_info.go:57-65)."""
+    gn = pod.metadata.annotations.get(GROUP_NAME_ANNOTATION_KEY, "")
+    if gn:
+        return f"{pod.metadata.namespace}/{gn}"
+    return ""
+
+
+class TaskInfo:
+    """All scheduler-relevant info about one task/pod (job_info.go:37-55)."""
+
+    __slots__ = (
+        "uid",
+        "job",
+        "name",
+        "namespace",
+        "resreq",
+        "init_resreq",
+        "node_name",
+        "status",
+        "priority",
+        "volume_ready",
+        "pod",
+    )
+
+    def __init__(
+        self,
+        uid: str,
+        job: str,
+        name: str,
+        namespace: str,
+        resreq: Resource,
+        init_resreq: Resource,
+        node_name: str = "",
+        status: TaskStatus = TaskStatus.PENDING,
+        priority: int = 1,
+        volume_ready: bool = False,
+        pod: Optional[objects.Pod] = None,
+    ):
+        self.uid = uid
+        self.job = job
+        self.name = name
+        self.namespace = namespace
+        self.resreq = resreq
+        self.init_resreq = init_resreq
+        self.node_name = node_name
+        self.status = status
+        self.priority = priority
+        self.volume_ready = volume_ready
+        self.pod = pod
+
+    def clone(self) -> "TaskInfo":
+        return TaskInfo(
+            uid=self.uid,
+            job=self.job,
+            name=self.name,
+            namespace=self.namespace,
+            resreq=self.resreq.clone(),
+            init_resreq=self.init_resreq.clone(),
+            node_name=self.node_name,
+            status=self.status,
+            priority=self.priority,
+            volume_ready=self.volume_ready,
+            pod=self.pod,
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"Task ({self.uid}:{self.namespace}/{self.name}): "
+            f"job {self.job}, status {self.status}, pri {self.priority}, "
+            f"resreq {self.resreq}"
+        )
+
+
+def new_task_info(pod: objects.Pod) -> TaskInfo:
+    """Build a TaskInfo from a Pod (job_info.go:68-92)."""
+    ti = TaskInfo(
+        uid=pod.metadata.uid,
+        job=get_job_id(pod),
+        name=pod.metadata.name,
+        namespace=pod.metadata.namespace,
+        resreq=get_pod_resource_without_init_containers(pod),
+        init_resreq=get_pod_resource_request(pod),
+        node_name=pod.spec.node_name,
+        status=get_task_status(pod),
+        priority=pod.spec.priority if pod.spec.priority is not None else 1,
+        pod=pod,
+    )
+    return ti
+
+
+class JobInfo:
+    """All info about one job (= PodGroup + its tasks), with resource
+    accounting kept incrementally (job_info.go:126-178)."""
+
+    def __init__(self, uid: str, *tasks: TaskInfo):
+        self.uid = uid
+        self.name = ""
+        self.namespace = ""
+        self.queue = ""
+        self.priority = 0
+        self.min_available = 0
+
+        self.nodes_fit_delta: Dict[str, Resource] = {}
+        self.job_fit_errors = ""
+        self.nodes_fit_errors: Dict[str, FitErrors] = {}
+
+        self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+        self.tasks: Dict[str, TaskInfo] = {}
+
+        self.allocated = Resource.empty()
+        self.total_request = Resource.empty()
+
+        self.creation_timestamp = 0.0
+        self.pod_group: Optional[objects.PodGroup] = None
+        self.pdb: Optional[objects.PodDisruptionBudget] = None
+
+        for task in tasks:
+            self.add_task_info(task)
+
+    # -- pod group / pdb binding ------------------------------------------
+
+    def set_pod_group(self, pg: objects.PodGroup) -> None:
+        self.name = pg.metadata.name
+        self.namespace = pg.metadata.namespace
+        self.min_available = pg.spec.min_member
+        self.queue = pg.spec.queue
+        self.creation_timestamp = pg.metadata.creation_timestamp
+        self.pod_group = pg
+
+    def unset_pod_group(self) -> None:
+        self.pod_group = None
+
+    def set_pdb(self, pdb: objects.PodDisruptionBudget) -> None:
+        self.name = pdb.metadata.name
+        self.namespace = pdb.metadata.namespace
+        self.min_available = pdb.min_available
+        self.creation_timestamp = pdb.metadata.creation_timestamp
+        self.pdb = pdb
+
+    def unset_pdb(self) -> None:
+        self.pdb = None
+
+    # -- task bookkeeping --------------------------------------------------
+
+    def _add_task_index(self, ti: TaskInfo) -> None:
+        self.task_status_index.setdefault(ti.status, {})[ti.uid] = ti
+
+    def _delete_task_index(self, ti: TaskInfo) -> None:
+        tasks = self.task_status_index.get(ti.status)
+        if tasks is not None:
+            tasks.pop(ti.uid, None)
+            if not tasks:
+                del self.task_status_index[ti.status]
+
+    def add_task_info(self, ti: TaskInfo) -> None:
+        self.tasks[ti.uid] = ti
+        self._add_task_index(ti)
+        self.total_request.add(ti.resreq)
+        if allocated_status(ti.status):
+            self.allocated.add(ti.resreq)
+
+    def delete_task_info(self, ti: TaskInfo) -> None:
+        task = self.tasks.get(ti.uid)
+        if task is None:
+            raise KeyError(
+                f"failed to find task <{ti.namespace}/{ti.name}> "
+                f"in job <{self.namespace}/{self.name}>"
+            )
+        self.total_request.sub(task.resreq)
+        if allocated_status(task.status):
+            self.allocated.sub(task.resreq)
+        del self.tasks[task.uid]
+        self._delete_task_index(task)
+
+    def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        """Move a task to a new status bucket, keeping the resource
+        accounting consistent. A task not currently in the job is simply
+        (re-)added under the new status — the reference discards the delete
+        error (job_info.go:232-245) and session code relies on that."""
+        try:
+            self.delete_task_info(task)
+        except KeyError:
+            pass
+        task.status = status
+        self.add_task_info(task)
+
+    # -- readiness math ----------------------------------------------------
+
+    def ready_task_num(self) -> int:
+        n = 0
+        for status, tasks in self.task_status_index.items():
+            if allocated_status(status) or status == TaskStatus.SUCCEEDED:
+                n += len(tasks)
+        return n
+
+    def waiting_task_num(self) -> int:
+        return len(self.task_status_index.get(TaskStatus.PIPELINED, {}))
+
+    def valid_task_num(self) -> int:
+        n = 0
+        for status, tasks in self.task_status_index.items():
+            if (
+                allocated_status(status)
+                or status == TaskStatus.SUCCEEDED
+                or status == TaskStatus.PIPELINED
+                or status == TaskStatus.PENDING
+            ):
+                n += len(tasks)
+        return n
+
+    def ready(self) -> bool:
+        return self.ready_task_num() >= self.min_available
+
+    def pipelined(self) -> bool:
+        return self.waiting_task_num() + self.ready_task_num() >= self.min_available
+
+    # -- misc --------------------------------------------------------------
+
+    def fit_error(self) -> str:
+        """Status histogram message for unschedulable conditions
+        (job_info.go:324-341)."""
+        reasons = {str(s): len(t) for s, t in self.task_status_index.items()}
+        reasons["minAvailable"] = self.min_available
+        parts = sorted(f"{v} {k}" for k, v in reasons.items())
+        return f"{objects.POD_GROUP_NOT_READY}, {', '.join(parts)}."
+
+    def clone(self) -> "JobInfo":
+        info = JobInfo(self.uid)
+        info.name = self.name
+        info.namespace = self.namespace
+        info.queue = self.queue
+        info.priority = self.priority
+        info.min_available = self.min_available
+        info.pdb = self.pdb
+        info.pod_group = self.pod_group
+        info.creation_timestamp = self.creation_timestamp
+        for task in self.tasks.values():
+            info.add_task_info(task.clone())
+        return info
+
+    def is_terminated(self) -> bool:
+        """helpers.go JobTerminated."""
+        return self.pod_group is None and self.pdb is None and not self.tasks
+
+    def __repr__(self) -> str:
+        return (
+            f"Job ({self.uid}): namespace {self.namespace} ({self.queue}), "
+            f"name {self.name}, minAvailable {self.min_available}, "
+            f"{len(self.tasks)} tasks"
+        )

@@ -1,0 +1,160 @@
+"""NodeInfo — per-node resource accounting (volcano pkg/scheduler/api/node_info.go).
+
+The node holds *clones* of tasks so later status flips on the session's task
+objects can't corrupt the accounting (node_info.go:196-197). Over-allocation
+flips the node to NotReady/OutOfSync instead of corrupting state
+(node_info.go:175-185).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.pod_helpers import pod_key
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import NodePhase, TaskStatus
+from volcano_tpu.api.job_info import TaskInfo
+
+
+class NodeState:
+    __slots__ = ("phase", "reason")
+
+    def __init__(self, phase: NodePhase, reason: str = ""):
+        self.phase = phase
+        self.reason = reason
+
+
+class NodeInfo:
+    """Node-level aggregated accounting: Idle/Used/Releasing vs
+    Allocatable/Capability (node_info.go:28-50)."""
+
+    def __init__(self, node: Optional[objects.Node] = None):
+        self.node = node
+        self.releasing = Resource.empty()
+        self.used = Resource.empty()
+        self.tasks: Dict[str, TaskInfo] = {}
+        self.others: Dict[str, object] = {}
+
+        if node is None:
+            self.name = ""
+            self.idle = Resource.empty()
+            self.allocatable = Resource.empty()
+            self.capability = Resource.empty()
+        else:
+            self.name = node.metadata.name
+            self.idle = Resource.from_resource_list(node.status.allocatable)
+            self.allocatable = Resource.from_resource_list(node.status.allocatable)
+            self.capability = Resource.from_resource_list(node.status.capacity)
+
+        self.state = NodeState(NodePhase.NOT_READY, "UnInitialized")
+        self._set_node_state(node)
+
+    # -- state -------------------------------------------------------------
+
+    def ready(self) -> bool:
+        return self.state.phase == NodePhase.READY
+
+    def _set_node_state(self, node: Optional[objects.Node]) -> None:
+        """(node_info.go:110-145)"""
+        if node is None:
+            self.state = NodeState(NodePhase.NOT_READY, "UnInitialized")
+            return
+        if not self.used.less_equal(Resource.from_resource_list(node.status.allocatable)):
+            self.state = NodeState(NodePhase.NOT_READY, "OutOfSync")
+            return
+        for cond in node.status.conditions:
+            if cond.type == "Ready" and cond.status != "True":
+                self.state = NodeState(NodePhase.NOT_READY, "NotReady")
+                return
+        self.state = NodeState(NodePhase.READY)
+
+    def set_node(self, node: objects.Node) -> None:
+        """Refresh from the node object, recomputing accounting from held
+        tasks (node_info.go:148-173)."""
+        self._set_node_state(node)
+        if not self.ready():
+            return
+
+        self.name = node.metadata.name
+        self.node = node
+        self.allocatable = Resource.from_resource_list(node.status.allocatable)
+        self.capability = Resource.from_resource_list(node.status.capacity)
+        self.idle = Resource.from_resource_list(node.status.allocatable)
+        self.used = Resource.empty()
+
+        for task in self.tasks.values():
+            if task.status == TaskStatus.RELEASING:
+                self.releasing.add(task.resreq)
+            self.idle.sub(task.resreq)
+            self.used.add(task.resreq)
+
+    # -- task accounting ---------------------------------------------------
+
+    def _allocate_idle(self, ti: TaskInfo) -> None:
+        if ti.resreq.less_equal(self.idle):
+            self.idle.sub(ti.resreq)
+            return
+        self.state = NodeState(NodePhase.NOT_READY, "OutOfSync")
+        raise RuntimeError("Selected node NotReady")
+
+    def add_task(self, task: TaskInfo) -> None:
+        """(node_info.go:188-220)"""
+        key = pod_key(task.pod) if task.pod is not None else f"{task.namespace}/{task.name}"
+        if key in self.tasks:
+            raise RuntimeError(
+                f"task <{task.namespace}/{task.name}> already on node <{self.name}>"
+            )
+        ti = task.clone()
+        if self.node is not None:
+            if ti.status == TaskStatus.RELEASING:
+                self._allocate_idle(ti)
+                self.releasing.add(ti.resreq)
+            elif ti.status == TaskStatus.PIPELINED:
+                self.releasing.sub(ti.resreq)
+            else:
+                self._allocate_idle(ti)
+            self.used.add(ti.resreq)
+        self.tasks[key] = ti
+
+    def remove_task(self, ti: TaskInfo) -> None:
+        """(node_info.go:223-249)"""
+        key = pod_key(ti.pod) if ti.pod is not None else f"{ti.namespace}/{ti.name}"
+        task = self.tasks.get(key)
+        if task is None:
+            raise RuntimeError(
+                f"failed to find task <{ti.namespace}/{ti.name}> on host <{self.name}>"
+            )
+        if self.node is not None:
+            if task.status == TaskStatus.RELEASING:
+                self.releasing.sub(task.resreq)
+                self.idle.add(task.resreq)
+            elif task.status == TaskStatus.PIPELINED:
+                self.releasing.add(task.resreq)
+            else:
+                self.idle.add(task.resreq)
+            self.used.sub(task.resreq)
+        del self.tasks[key]
+
+    def update_task(self, ti: TaskInfo) -> None:
+        self.remove_task(ti)
+        self.add_task(ti)
+
+    # -- misc --------------------------------------------------------------
+
+    def clone(self) -> "NodeInfo":
+        res = NodeInfo(self.node)
+        for task in self.tasks.values():
+            res.add_task(task)
+        res.others = self.others
+        return res
+
+    def pods(self) -> list:
+        return [t.pod for t in self.tasks.values()]
+
+    def __repr__(self) -> str:
+        return (
+            f"Node ({self.name}): idle <{self.idle}>, used <{self.used}>, "
+            f"releasing <{self.releasing}>, state <{self.state.phase}, "
+            f"{self.state.reason}>"
+        )

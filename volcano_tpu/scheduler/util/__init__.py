@@ -1,0 +1,2 @@
+"""Scheduler helpers: priority queue, parallel predicate/score helpers,
+test object builders and fake effectors."""

@@ -1,0 +1,1 @@
+"""Shared low-level utilities."""

@@ -1,0 +1,189 @@
+"""enqueue / backfill / preempt / reclaim action tests
+(mirrors the respective *_test.go suites)."""
+
+from tests.helpers import make_cache, make_tiers
+from volcano_tpu.api import objects
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.scheduler.framework import close_session, get_action, open_session
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list,
+)
+
+
+def rl(cpu, mem, pods=110):
+    r = build_resource_list(cpu, mem)
+    r["pods"] = pods
+    return r
+
+
+class SessionResult:
+    def __init__(self, jobs):
+        self.jobs = jobs
+
+
+def run_actions(cache, tiers, *action_names):
+    ssn = open_session(cache, tiers)
+    for name in action_names:
+        get_action(name).execute(ssn)
+    jobs = dict(ssn.jobs)  # close_session clears session state
+    close_session(ssn)
+    return SessionResult(jobs)
+
+
+class TestEnqueue:
+    def test_pending_pg_flips_to_inqueue(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_node(build_node("n1", rl("4", "8Gi")))
+        pg = build_pod_group("pg1", namespace="c1", min_member=1,
+                             phase=objects.PodGroupPhase.PENDING,
+                             min_resources=build_resource_list("1", "1Gi"))
+        c.add_pod_group(pg)
+        ssn = run_actions(c, make_tiers(["gang"], ["proportion"]), "enqueue")
+        job = ssn.jobs["c1/pg1"]
+        assert job.pod_group.status.phase == objects.PodGroupPhase.INQUEUE
+
+    def test_overcommit_cap(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_node(build_node("n1", rl("4", "8Gi")))
+        # min_resources larger than 1.2x the cluster -> stays pending
+        pg = build_pod_group("pg1", namespace="c1", min_member=1,
+                             phase=objects.PodGroupPhase.PENDING,
+                             min_resources=build_resource_list("50", "100Gi"))
+        c.add_pod_group(pg)
+        ssn = run_actions(c, make_tiers(["gang"], ["proportion"]), "enqueue")
+        assert ssn.jobs["c1/pg1"].pod_group.status.phase == objects.PodGroupPhase.PENDING
+
+    def test_no_min_resources_always_inqueue(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        pg = build_pod_group("pg1", namespace="c1",
+                             phase=objects.PodGroupPhase.PENDING)
+        c.add_pod_group(pg)
+        ssn = run_actions(c, make_tiers(["gang"], ["proportion"]), "enqueue")
+        assert ssn.jobs["c1/pg1"].pod_group.status.phase == objects.PodGroupPhase.INQUEUE
+
+    def test_queue_capability_cap(self):
+        c = make_cache()
+        c.add_queue(build_queue("default", capability=build_resource_list("2", "4Gi")))
+        c.add_node(build_node("n1", rl("16", "32Gi")))
+        pg = build_pod_group("pg1", namespace="c1",
+                             phase=objects.PodGroupPhase.PENDING,
+                             min_resources=build_resource_list("4", "8Gi"))
+        c.add_pod_group(pg)
+        ssn = run_actions(c, make_tiers(["gang"], ["proportion"]), "enqueue")
+        assert ssn.jobs["c1/pg1"].pod_group.status.phase == objects.PodGroupPhase.PENDING
+
+
+class TestBackfill:
+    def test_best_effort_placed(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pod_group(build_pod_group("pg1", namespace="c1", min_member=1))
+        c.add_pod(build_pod("c1", "be", "", objects.POD_PHASE_PENDING, {}, "pg1"))
+        c.add_node(build_node("n1", rl("1", "1Gi")))
+        run_actions(c, make_tiers(["gang"], ["predicates"]), "backfill")
+        assert c.binder.binds == {"c1/be": "n1"}
+
+    def test_non_best_effort_ignored(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pod_group(build_pod_group("pg1", namespace="c1", min_member=1))
+        c.add_pod(build_pod("c1", "p1", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg1"))
+        c.add_node(build_node("n1", rl("4", "8Gi")))
+        run_actions(c, make_tiers(["gang"], ["predicates"]), "backfill")
+        assert c.binder.binds == {}
+
+
+class TestPreempt:
+    def build(self):
+        """One node fully used by low-priority pg1; high-priority pg2 pending."""
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_priority_class(objects.PriorityClass(
+            metadata=objects.ObjectMeta(name="high"), value=1000))
+        c.add_priority_class(objects.PriorityClass(
+            metadata=objects.ObjectMeta(name="low"), value=1))
+        pg1 = build_pod_group("pg1", namespace="c1", min_member=1)
+        pg1.spec.priority_class_name = "low"
+        c.add_pod_group(pg1)
+        pg2 = build_pod_group("pg2", namespace="c1", min_member=1)
+        pg2.spec.priority_class_name = "high"
+        c.add_pod_group(pg2)
+        for i in range(2):
+            c.add_pod(build_pod("c1", f"low-{i}", "n1", objects.POD_PHASE_RUNNING,
+                                build_resource_list("2", "4Gi"), "pg1", priority=1))
+        c.add_pod(build_pod("c1", "high", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("2", "4Gi"), "pg2", priority=1000))
+        c.add_node(build_node("n1", rl("4", "8Gi")))
+        return c
+
+    def test_preempts_lower_priority(self):
+        c = self.build()
+        tiers = make_tiers(["priority", "gang", "conformance"], ["drf", "predicates"])
+        ssn = run_actions(c, tiers, "preempt")
+        assert len(c.evictor.evicts) >= 1
+        assert c.evictor.evicts[0].startswith("c1/low-")
+        # preemptor pipelined onto the node
+        job2 = ssn.jobs["c1/pg2"]
+        assert len(job2.task_status_index.get(TaskStatus.PIPELINED, {})) == 1
+
+    def test_no_preemption_when_gang_would_break(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        # low job needs both tasks (min_member=2): gang forbids eviction
+        c.add_pod_group(build_pod_group("pg1", namespace="c1", min_member=2))
+        c.add_pod_group(build_pod_group("pg2", namespace="c1", min_member=1))
+        for i in range(2):
+            c.add_pod(build_pod("c1", f"low-{i}", "n1", objects.POD_PHASE_RUNNING,
+                                build_resource_list("2", "4Gi"), "pg1", priority=1))
+        c.add_pod(build_pod("c1", "high", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("2", "4Gi"), "pg2", priority=1000))
+        c.add_node(build_node("n1", rl("4", "8Gi")))
+        tiers = make_tiers(["priority", "gang", "conformance"], ["drf", "predicates"])
+        run_actions(c, tiers, "preempt")
+        assert c.evictor.evicts == []
+
+
+class TestReclaim:
+    def test_starved_queue_reclaims(self):
+        c = make_cache()
+        c.add_queue(build_queue("q1", weight=1))
+        c.add_queue(build_queue("q2", weight=1))
+        # q1 occupies the whole node; q2's job is starved
+        c.add_pod_group(build_pod_group("pg1", namespace="c1", min_member=1, queue="q1"))
+        c.add_pod_group(build_pod_group("pg2", namespace="c1", min_member=1, queue="q2"))
+        for i in range(2):
+            c.add_pod(build_pod("c1", f"q1-{i}", "n1", objects.POD_PHASE_RUNNING,
+                                build_resource_list("2", "4Gi"), "pg1"))
+        c.add_pod(build_pod("c1", "starved", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("2", "4Gi"), "pg2"))
+        c.add_node(build_node("n1", rl("4", "8Gi")))
+        tiers = make_tiers(["priority", "gang", "conformance"],
+                           ["drf", "proportion", "predicates"])
+        ssn = run_actions(c, tiers, "reclaim")
+        assert len(c.evictor.evicts) >= 1
+        assert c.evictor.evicts[0].startswith("c1/q1-")
+
+    def test_no_reclaim_within_deserved(self):
+        c = make_cache()
+        c.add_queue(build_queue("q1", weight=1))
+        c.add_queue(build_queue("q2", weight=1))
+        c.add_pod_group(build_pod_group("pg1", namespace="c1", min_member=1, queue="q1"))
+        c.add_pod_group(build_pod_group("pg2", namespace="c1", min_member=1, queue="q2"))
+        # q1 uses only half the node (its deserved share) -> nothing to reclaim
+        c.add_pod(build_pod("c1", "q1-0", "n1", objects.POD_PHASE_RUNNING,
+                            build_resource_list("2", "4Gi"), "pg1"))
+        c.add_pod(build_pod("c1", "starved", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("4", "8Gi"), "pg2"))
+        c.add_node(build_node("n1", rl("4", "8Gi")))
+        tiers = make_tiers(["priority", "gang", "conformance"],
+                           ["drf", "proportion", "predicates"])
+        run_actions(c, tiers, "reclaim")
+        assert c.evictor.evicts == []

@@ -1,0 +1,132 @@
+"""End-to-end allocate action tests
+(mirrors pkg/scheduler/actions/allocate/allocate_test.go)."""
+
+from tests.helpers import make_cache, make_tiers
+from volcano_tpu.api import objects
+from volcano_tpu.scheduler.framework import close_session, get_action, open_session
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list,
+)
+
+
+def run_allocate(cache, tiers):
+    ssn = open_session(cache, tiers)
+    get_action("allocate").execute(ssn)
+    close_session(ssn)
+    return ssn
+
+
+class TestAllocate:
+    def test_one_job_two_tasks(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pod_group(build_pod_group("pg1", namespace="c1", min_member=0))
+        c.add_pod(build_pod("c1", "p1", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg1"))
+        c.add_pod(build_pod("c1", "p2", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg1"))
+        c.add_node(build_node("n1", build_resource_list_pods("2", "4Gi")))
+        run_allocate(c, make_tiers(["drf", "proportion"]))
+        assert c.binder.binds == {"c1/p1": "n1", "c1/p2": "n1"}
+
+    def test_two_jobs_on_one_node_fair(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        for pg in ("pg1", "pg2"):
+            c.add_pod_group(build_pod_group(pg, namespace="c1", min_member=0))
+        c.add_pod(build_pod("c1", "p1", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg1"))
+        c.add_pod(build_pod("c1", "p2", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg1"))
+        c.add_pod(build_pod("c1", "p3", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg2"))
+        c.add_pod(build_pod("c1", "p4", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg2"))
+        c.add_node(build_node("n1", build_resource_list_pods("2", "4Gi")))
+        run_allocate(c, make_tiers(["drf", "proportion"]))
+        # DRF alternates between the jobs: one task each
+        assert len(c.binder.binds) == 2
+        bound_jobs = {k.split("/")[1][0:2] for k in c.binder.binds}
+        assert len(c.binder.binds) == 2
+
+    def test_gang_all_or_nothing(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        # gang of 3, but only capacity for 2 -> nothing binds
+        c.add_pod_group(build_pod_group("pg1", namespace="c1", min_member=3))
+        for i in range(3):
+            c.add_pod(build_pod("c1", f"p{i}", "", objects.POD_PHASE_PENDING,
+                                build_resource_list("1", "1Gi"), "pg1"))
+        c.add_node(build_node("n1", build_resource_list_pods("2", "4Gi")))
+        run_allocate(c, make_tiers(["gang"], ["drf", "proportion"]))
+        assert c.binder.binds == {}
+
+    def test_gang_fits(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pod_group(build_pod_group("pg1", namespace="c1", min_member=3))
+        for i in range(3):
+            c.add_pod(build_pod("c1", f"p{i}", "", objects.POD_PHASE_PENDING,
+                                build_resource_list("1", "1Gi"), "pg1"))
+        c.add_node(build_node("n1", build_resource_list_pods("4", "8Gi")))
+        run_allocate(c, make_tiers(["gang"], ["drf", "proportion"]))
+        assert len(c.binder.binds) == 3
+
+    def test_pending_podgroup_not_allocated(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pod_group(build_pod_group("pg1", namespace="c1", min_member=1,
+                                        phase=objects.PodGroupPhase.PENDING))
+        c.add_pod(build_pod("c1", "p1", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg1"))
+        c.add_node(build_node("n1", build_resource_list_pods("4", "8Gi")))
+        run_allocate(c, make_tiers(["gang"], ["drf", "proportion"]))
+        assert c.binder.binds == {}
+
+    def test_node_selector_respected(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pod_group(build_pod_group("pg1", namespace="c1", min_member=1))
+        c.add_pod(build_pod("c1", "p1", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg1",
+                            node_selector={"zone": "a"}))
+        c.add_node(build_node("n1", build_resource_list_pods("4", "8Gi"),
+                              labels={"zone": "b"}))
+        c.add_node(build_node("n2", build_resource_list_pods("4", "8Gi"),
+                              labels={"zone": "a"}))
+        run_allocate(c, make_tiers(["gang"], ["drf", "proportion", "predicates"]))
+        assert c.binder.binds == {"c1/p1": "n2"}
+
+    def test_binpack_prefers_used_node(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pod_group(build_pod_group("pg0", namespace="c1", min_member=0))
+        # n2 already has a running pod
+        c.add_pod(build_pod("c1", "existing", "n2", objects.POD_PHASE_RUNNING,
+                            build_resource_list("2", "4Gi"), "pg0"))
+        c.add_pod_group(build_pod_group("pg1", namespace="c1", min_member=1))
+        c.add_pod(build_pod("c1", "p1", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg1"))
+        for n in ("n1", "n2"):
+            c.add_node(build_node(n, build_resource_list_pods("8", "16Gi")))
+        run_allocate(c, make_tiers(["gang"], ["binpack"]))
+        assert c.binder.binds == {"c1/p1": "n2"}
+
+    def test_queue_missing_skips_job(self):
+        c = make_cache()
+        c.add_pod_group(build_pod_group("pg1", namespace="c1", queue="nope"))
+        c.add_pod(build_pod("c1", "p1", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg1"))
+        c.add_node(build_node("n1", build_resource_list_pods("4", "8Gi")))
+        run_allocate(c, make_tiers(["gang"], ["drf"]))
+        assert c.binder.binds == {}
+
+
+def build_resource_list_pods(cpu, mem):
+    rl = build_resource_list(cpu, mem)
+    rl["pods"] = 110
+    return rl

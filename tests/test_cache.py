@@ -1,0 +1,221 @@
+"""SchedulerCache tests (mirrors pkg/scheduler/cache/{cache,event_handlers}_test.go)."""
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.scheduler.cache import SchedulerCache
+from volcano_tpu.scheduler.util.test_utils import (
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+    FakeVolumeBinder,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list,
+)
+from volcano_tpu.store import Store
+
+
+def make_cache(store=None):
+    return SchedulerCache(
+        store=store,
+        binder=FakeBinder(),
+        evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater(),
+        volume_binder=FakeVolumeBinder(),
+    )
+
+
+class TestEventHandlers:
+    def test_add_pod_creates_shadow_job(self):
+        c = make_cache()
+        c.add_pod(build_pod("ns1", "p1", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg1"))
+        assert "ns1/pg1" in c.jobs
+        assert len(c.jobs["ns1/pg1"].tasks) == 1
+
+    def test_bound_pod_on_unknown_node_makes_shadow_node(self):
+        c = make_cache()
+        c.add_pod(build_pod("ns1", "p1", "ghost", objects.POD_PHASE_RUNNING,
+                            build_resource_list("1", "1Gi"), "pg1"))
+        assert "ghost" in c.nodes
+        assert not c.nodes["ghost"].ready()  # uninitialized
+
+    def test_other_scheduler_pod_ignored(self):
+        c = make_cache()
+        pod = build_pod("ns1", "p1", "", objects.POD_PHASE_PENDING,
+                        build_resource_list("1", "1Gi"))
+        pod.spec.scheduler_name = "default-scheduler"
+        c.add_pod(pod)
+        assert not c.jobs
+
+    def test_pod_group_binds_to_job(self):
+        c = make_cache()
+        c.add_pod(build_pod("ns1", "p1", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg1"))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns1", min_member=1, queue="q1"))
+        job = c.jobs["ns1/pg1"]
+        assert job.min_available == 1
+        assert job.queue == "q1"
+
+    def test_pod_group_default_queue(self):
+        c = make_cache()
+        pg = build_pod_group("pg1", namespace="ns1", queue="")
+        c.add_pod_group(pg)
+        assert c.jobs["ns1/pg1"].queue == "default"
+
+    def test_delete_pod_then_podgroup_removes_job(self):
+        c = make_cache()
+        pod = build_pod("ns1", "p1", "", objects.POD_PHASE_PENDING,
+                        build_resource_list("1", "1Gi"), "pg1")
+        c.add_pod(pod)
+        c.add_pod_group(build_pod_group("pg1", namespace="ns1"))
+        c.delete_pod(pod)
+        c.delete_pod_group(build_pod_group("pg1", namespace="ns1"))
+        assert "ns1/pg1" not in c.jobs
+
+
+class TestSnapshot:
+    def build(self):
+        c = make_cache()
+        c.add_node(build_node("n1", build_resource_list("8", "16Gi")))
+        c.add_node(build_node("n2", build_resource_list("8", "16Gi")))
+        c.add_queue(build_queue("q1", weight=2))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns1", min_member=2, queue="q1"))
+        for i in range(2):
+            c.add_pod(build_pod("ns1", f"p{i}", "", objects.POD_PHASE_PENDING,
+                                build_resource_list("1", "1Gi"), "pg1"))
+        return c
+
+    def test_snapshot_contents(self):
+        snap = self.build().snapshot()
+        assert set(snap.nodes) == {"n1", "n2"}
+        assert set(snap.queues) == {"q1"}
+        assert set(snap.jobs) == {"ns1/pg1"}
+        assert len(snap.jobs["ns1/pg1"].tasks) == 2
+
+    def test_snapshot_is_deep(self):
+        c = self.build()
+        snap = c.snapshot()
+        task = next(iter(snap.jobs["ns1/pg1"].tasks.values()))
+        snap.jobs["ns1/pg1"].update_task_status(task, TaskStatus.ALLOCATED)
+        assert c.jobs["ns1/pg1"].allocated.milli_cpu == 0
+
+    def test_snapshot_skips_jobs_without_queue(self):
+        c = make_cache()
+        c.add_pod_group(build_pod_group("pg1", namespace="ns1", queue="missing"))
+        snap = c.snapshot()
+        assert not snap.jobs
+
+    def test_snapshot_skips_jobs_without_podgroup(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pod(build_pod("ns1", "p1", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg1"))
+        snap = c.snapshot()
+        assert not snap.jobs
+
+    def test_snapshot_skips_not_ready_nodes(self):
+        c = self.build()
+        bad = build_node("n3", build_resource_list("1", "1Gi"))
+        bad.status.conditions = [objects.NodeCondition(type="Ready", status="False")]
+        c.add_node(bad)
+        assert "n3" not in c.snapshot().nodes
+
+    def test_priority_class_applied(self):
+        c = self.build()
+        pg = build_pod_group("pg2", namespace="ns1", queue="q1")
+        pg.spec.priority_class_name = "high"
+        c.add_pod_group(pg)
+        c.add_priority_class(objects.PriorityClass(
+            metadata=objects.ObjectMeta(name="high"), value=1000))
+        snap = c.snapshot()
+        assert snap.jobs["ns1/pg2"].priority == 1000
+        assert snap.jobs["ns1/pg1"].priority == 0
+
+
+class TestBindEvict:
+    def setup_cache(self):
+        c = make_cache()
+        c.add_node(build_node("n1", build_resource_list("8", "16Gi")))
+        c.add_queue(build_queue("q1"))
+        c.add_pod_group(build_pod_group("pg1", namespace="ns1", min_member=1, queue="q1"))
+        pod = build_pod("ns1", "p1", "", objects.POD_PHASE_PENDING,
+                        build_resource_list("2", "4Gi"), "pg1")
+        c.add_pod(pod)
+        return c
+
+    def test_bind(self):
+        c = self.setup_cache()
+        task = next(iter(c.jobs["ns1/pg1"].tasks.values()))
+        c.bind(task, "n1")
+        assert c.binder.binds == {"ns1/p1": "n1"}
+        assert task.status == TaskStatus.BINDING
+        assert c.nodes["n1"].idle.milli_cpu == 6000
+
+    def test_bind_unknown_host_raises(self):
+        import pytest
+
+        c = self.setup_cache()
+        task = next(iter(c.jobs["ns1/pg1"].tasks.values()))
+        with pytest.raises(KeyError):
+            c.bind(task, "nope")
+
+    def test_bind_failure_resyncs(self):
+        class FailingBinder:
+            def bind(self, pod, hostname):
+                raise RuntimeError("apiserver down")
+
+        store = Store()
+        c = SchedulerCache(store=store, binder=FailingBinder(),
+                           evictor=FakeEvictor(), status_updater=FakeStatusUpdater(),
+                           volume_binder=FakeVolumeBinder())
+        c.run()
+        store.create(build_node("n1", build_resource_list("8", "16Gi")))
+        store.create(build_queue("q1"))
+        store.create(build_pod_group("pg1", namespace="ns1", min_member=1, queue="q1"))
+        store.create(build_pod("ns1", "p1", "", objects.POD_PHASE_PENDING,
+                               build_resource_list("2", "4Gi"), "pg1"))
+        task = next(iter(c.jobs["ns1/pg1"].tasks.values()))
+        c.bind(task, "n1")
+        assert len(c._err_tasks) == 1
+        # resync re-fetches truth: pod in store is still unbound/pending
+        c.process_resync_tasks()
+        assert not c._err_tasks
+        job_task = next(iter(c.jobs["ns1/pg1"].tasks.values()))
+        assert job_task.status == TaskStatus.PENDING
+        assert c.nodes["n1"].idle.milli_cpu == 8000
+
+    def test_evict(self):
+        c = self.setup_cache()
+        task = next(iter(c.jobs["ns1/pg1"].tasks.values()))
+        c.bind(task, "n1")
+        c.evict(task, "preempted")
+        assert c.evictor.evicts == ["ns1/p1"]
+        assert task.status == TaskStatus.RELEASING
+        assert c.nodes["n1"].releasing.milli_cpu == 2000
+
+
+class TestStoreIntegration:
+    def test_watch_driven_mirror(self):
+        store = Store()
+        c = make_cache(store)
+        c.run()
+        store.create(build_node("n1", build_resource_list("4", "8Gi")))
+        store.create(build_queue("default"))
+        pg = store.create(build_pod_group("pg1"))
+        pod = store.create(build_pod("default", "p1", "", objects.POD_PHASE_PENDING,
+                                     build_resource_list("1", "1Gi"), "pg1"))
+        assert "n1" in c.nodes
+        assert "default/pg1" in c.jobs
+        assert len(c.jobs["default/pg1"].tasks) == 1
+        # pod phase transition via store update flows through
+        pod.status.phase = objects.POD_PHASE_RUNNING
+        pod.spec.node_name = "n1"
+        store.update(pod)
+        task = next(iter(c.jobs["default/pg1"].tasks.values()))
+        assert task.status == TaskStatus.RUNNING
+        assert c.nodes["n1"].used.milli_cpu == 1000
+        store.delete("Pod", "default", "p1")
+        assert not c.jobs["default/pg1"].tasks

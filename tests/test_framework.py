@@ -1,0 +1,188 @@
+"""Framework semantics tests: tiered dispatch, statement commit/rollback,
+priority queue, job updater dedup."""
+
+from tests.helpers import make_cache, make_tiers
+from volcano_tpu.api import objects
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.scheduler import conf
+from volcano_tpu.scheduler.framework import open_session
+from volcano_tpu.scheduler.framework.session import Session
+from volcano_tpu.scheduler.util.priority_queue import PriorityQueue
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list,
+)
+
+
+def rl(cpu, mem):
+    r = build_resource_list(cpu, mem)
+    r["pods"] = 110
+    return r
+
+
+def make_session_with_cluster(tiers=None, nodes=1, gang_size=2, min_member=2):
+    c = make_cache()
+    c.add_queue(build_queue("default"))
+    c.add_pod_group(build_pod_group("pg1", namespace="c1", min_member=min_member))
+    for i in range(gang_size):
+        c.add_pod(build_pod("c1", f"p{i}", "", objects.POD_PHASE_PENDING,
+                            build_resource_list("1", "1Gi"), "pg1"))
+    for n in range(nodes):
+        c.add_node(build_node(f"n{n}", rl("8", "16Gi")))
+    ssn = open_session(c, tiers if tiers is not None else make_tiers(["gang"]))
+    return c, ssn
+
+
+class TestTieredDispatch:
+    def _session_with_tiers(self, *tier_names):
+        ssn = Session.__new__(Session)
+        Session.__init__(ssn, cache=None)
+        ssn.tiers = make_tiers(*tier_names)
+        return ssn
+
+    def test_victim_intersection_within_tier(self):
+        ssn = self._session_with_tiers(["a", "b"])
+
+        class T:
+            def __init__(self, uid):
+                self.uid = uid
+
+        t1, t2, t3 = T("1"), T("2"), T("3")
+        ssn.add_preemptable_fn("a", lambda p, lst: [t1, t2])
+        ssn.add_preemptable_fn("b", lambda p, lst: [t2, t3])
+        assert ssn.preemptable(None, [t1, t2, t3]) == [t2]
+
+    def test_first_deciding_tier_wins(self):
+        ssn = self._session_with_tiers(["a"], ["b"])
+
+        class T:
+            def __init__(self, uid):
+                self.uid = uid
+
+        t1, t2 = T("1"), T("2")
+        ssn.add_preemptable_fn("a", lambda p, lst: [t1])
+        ssn.add_preemptable_fn("b", lambda p, lst: [t2])
+        # tier 1 decides (non-None result), tier 2 never consulted
+        assert ssn.preemptable(None, [t1, t2]) == [t1]
+
+    def test_empty_first_tier_decides_no_victims(self):
+        ssn = self._session_with_tiers(["a"], ["b"])
+
+        class T:
+            def __init__(self, uid):
+                self.uid = uid
+
+        t1 = T("1")
+        ssn.add_preemptable_fn("a", lambda p, lst: [])
+        ssn.add_preemptable_fn("b", lambda p, lst: [t1])
+        # [] is non-None: tier 1 decided there are no victims
+        assert ssn.preemptable(None, [t1]) == []
+
+    def test_order_first_nonzero_wins(self):
+        ssn = self._session_with_tiers(["a", "b"])
+        ssn.add_job_order_fn("a", lambda l, r: 0)
+        ssn.add_job_order_fn("b", lambda l, r: -1)
+
+        class J:
+            creation_timestamp = 0
+            uid = "x"
+
+        assert ssn.job_order_fn(J(), J()) is True
+
+    def test_job_ready_is_and(self):
+        ssn = self._session_with_tiers(["a", "b"])
+        ssn.add_job_ready_fn("a", lambda j: True)
+        ssn.add_job_ready_fn("b", lambda j: False)
+        assert ssn.job_ready(None) is False
+
+    def test_overused_is_or(self):
+        ssn = self._session_with_tiers(["a", "b"])
+        ssn.add_overused_fn("a", lambda q: False)
+        ssn.add_overused_fn("b", lambda q: True)
+        assert ssn.overused(None) is True
+
+    def test_disabled_flag_skips_plugin(self):
+        ssn = Session.__new__(Session)
+        Session.__init__(ssn, cache=None)
+        option = conf.PluginOption(name="a")
+        from volcano_tpu.scheduler.plugins import apply_plugin_conf_defaults
+
+        apply_plugin_conf_defaults(option)
+        option.enabled_job_ready = False
+        ssn.tiers = [conf.Tier(plugins=[option])]
+        ssn.add_job_ready_fn("a", lambda j: False)
+        assert ssn.job_ready(None) is True  # disabled -> not consulted
+
+    def test_node_order_sums(self):
+        ssn = self._session_with_tiers(["a", "b"])
+        ssn.add_node_order_fn("a", lambda t, n: 3.0)
+        ssn.add_node_order_fn("b", lambda t, n: 4.0)
+        assert ssn.node_order_fn(None, None) == 7.0
+
+
+class TestStatement:
+    def test_commit_binds(self):
+        c, ssn = make_session_with_cluster(min_member=2)
+        stmt = ssn.statement()
+        job = ssn.jobs["c1/pg1"]
+        tasks = list(job.task_status_index[TaskStatus.PENDING].values())
+        for t in tasks:
+            stmt.allocate(t, "n0")
+        assert c.binder.binds == {}  # nothing until commit
+        stmt.commit()
+        assert len(c.binder.binds) == 2
+
+    def test_discard_restores_state(self):
+        c, ssn = make_session_with_cluster(min_member=2)
+        job = ssn.jobs["c1/pg1"]
+        node = ssn.nodes["n0"]
+        idle_before = node.idle.milli_cpu
+        stmt = ssn.statement()
+        t = next(iter(job.task_status_index[TaskStatus.PENDING].values()))
+        stmt.allocate(t, "n0")
+        assert node.idle.milli_cpu == idle_before - 1000
+        assert job.ready_task_num() == 1
+        stmt.discard()
+        assert node.idle.milli_cpu == idle_before
+        assert job.ready_task_num() == 0
+        assert t.status == TaskStatus.PENDING
+        assert c.binder.binds == {}
+
+    def test_discard_reverses_evict(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        c.add_pod_group(build_pod_group("pg1", namespace="c1", min_member=1))
+        c.add_pod(build_pod("c1", "r1", "n0", objects.POD_PHASE_RUNNING,
+                            build_resource_list("2", "4Gi"), "pg1"))
+        c.add_node(build_node("n0", rl("8", "16Gi")))
+        ssn = open_session(c, make_tiers(["gang"]))
+        job = ssn.jobs["c1/pg1"]
+        task = next(iter(job.task_status_index[TaskStatus.RUNNING].values()))
+        node = ssn.nodes["n0"]
+        stmt = ssn.statement()
+        stmt.evict(task, "test")
+        assert node.releasing.milli_cpu == 2000
+        stmt.discard()
+        assert node.releasing.milli_cpu == 0
+        assert task.status == TaskStatus.RUNNING
+        assert c.evictor.evicts == []
+
+
+class TestPriorityQueue:
+    def test_ordering(self):
+        q = PriorityQueue(lambda l, r: l < r)
+        for v in [5, 1, 3]:
+            q.push(v)
+        assert [q.pop(), q.pop(), q.pop()] == [1, 3, 5]
+
+    def test_stability(self):
+        q = PriorityQueue(lambda l, r: False)  # all equal
+        for v in ["a", "b", "c"]:
+            q.push(v)
+        assert [q.pop(), q.pop(), q.pop()] == ["a", "b", "c"]
+
+    def test_empty_pop(self):
+        assert PriorityQueue().pop() is None

@@ -1,0 +1,117 @@
+"""State store tests: CRUD, watches, admission middleware, events."""
+
+import pytest
+
+from volcano_tpu.api import objects
+from volcano_tpu.scheduler.util.test_utils import build_node, build_pod, build_queue, build_resource_list
+from volcano_tpu.store import AdmissionError, ConflictError, NotFoundError, Store, WatchHandler
+
+
+def make_pod(name="p1", ns="default"):
+    return build_pod(ns, name, "", objects.POD_PHASE_PENDING,
+                     build_resource_list("1", "1Gi"), "pg1")
+
+
+class TestCrud:
+    def test_create_get(self):
+        s = Store()
+        pod = s.create(make_pod())
+        assert pod.metadata.resource_version == 1
+        assert s.get("Pod", "default", "p1") is pod
+
+    def test_create_conflict(self):
+        s = Store()
+        s.create(make_pod())
+        with pytest.raises(ConflictError):
+            s.create(make_pod())
+
+    def test_update_bumps_version(self):
+        s = Store()
+        pod = s.create(make_pod())
+        pod.status.phase = objects.POD_PHASE_RUNNING
+        s.update(pod)
+        assert pod.metadata.resource_version == 2
+
+    def test_update_missing(self):
+        s = Store()
+        with pytest.raises(NotFoundError):
+            s.update(make_pod())
+
+    def test_delete(self):
+        s = Store()
+        s.create(make_pod())
+        s.delete("Pod", "default", "p1")
+        assert s.try_get("Pod", "default", "p1") is None
+
+    def test_cluster_scoped(self):
+        s = Store()
+        s.create(build_node("n1", build_resource_list("4", "8Gi")))
+        s.create(build_queue("q1"))
+        assert s.get("Node", "", "n1").metadata.name == "n1"
+        assert s.get("Queue", "", "q1").metadata.name == "q1"
+
+    def test_list_with_namespace_and_selector(self):
+        s = Store()
+        p = make_pod("a")
+        p.metadata.labels["app"] = "x"
+        s.create(p)
+        s.create(make_pod("b"))
+        s.create(make_pod("c", ns="other"))
+        assert len(s.list("Pod")) == 3
+        assert len(s.list("Pod", namespace="default")) == 2
+        assert len(s.list("Pod", selector={"app": "x"})) == 1
+
+
+class TestWatch:
+    def test_watch_events(self):
+        s = Store()
+        seen = []
+        s.watch("Pod", WatchHandler(
+            added=lambda o: seen.append(("add", o.metadata.name)),
+            updated=lambda old, new: seen.append(("upd", new.metadata.name)),
+            deleted=lambda o: seen.append(("del", o.metadata.name)),
+        ))
+        pod = s.create(make_pod())
+        s.update(pod)
+        s.delete("Pod", "default", "p1")
+        assert seen == [("add", "p1"), ("upd", "p1"), ("del", "p1")]
+
+    def test_watch_replay(self):
+        s = Store()
+        s.create(make_pod("a"))
+        s.create(make_pod("b"))
+        seen = []
+        s.watch("Pod", WatchHandler(added=lambda o: seen.append(o.metadata.name)))
+        assert sorted(seen) == ["a", "b"]
+
+
+class TestAdmission:
+    def test_mutator_then_validator(self):
+        s = Store()
+        s.register_admission(
+            "Pod",
+            mutator=lambda p: p.metadata.labels.__setitem__("mutated", "yes"),
+            validator=lambda p: None,
+        )
+        pod = s.create(make_pod())
+        assert pod.metadata.labels["mutated"] == "yes"
+
+    def test_validator_rejects(self):
+        def reject(pod):
+            raise AdmissionError("no")
+
+        s = Store()
+        s.register_admission("Pod", validator=reject)
+        with pytest.raises(AdmissionError):
+            s.create(make_pod())
+        assert s.try_get("Pod", "default", "p1") is None
+
+
+class TestEvents:
+    def test_record(self):
+        s = Store()
+        pod = s.create(make_pod())
+        s.record_event(pod, "Warning", "FailedScheduling", "no nodes")
+        evs = s.events_for(pod)
+        assert len(evs) == 1
+        assert evs[0].reason == "FailedScheduling"

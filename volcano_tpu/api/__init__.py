@@ -42,7 +42,7 @@ from volcano_tpu.api.node_info import NodeInfo
 from volcano_tpu.api.queue_info import QueueInfo
 from volcano_tpu.api.namespace_info import NamespaceInfo, NamespaceCollection
 from volcano_tpu.api.cluster_info import ClusterInfo
-from volcano_tpu.api.unschedule_info import FitError, FitErrors
+from volcano_tpu.api.unschedule_info import FitError, FitErrors, FitFailure
 from volcano_tpu.api.pod_helpers import (
     pod_key,
     get_pod_resource_request,

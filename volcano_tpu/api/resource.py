@@ -31,9 +31,16 @@ _NATIVE = ("cpu", "memory", "pods")
 
 
 def is_scalar_resource_name(name: str) -> bool:
-    """Extended/scalar resources: domain-prefixed names ("vendor.com/res")
-    and hugepages (mirrors k8s v1helper.IsScalarResourceName)."""
-    return "/" in name or name.startswith("hugepages-")
+    """Mirrors k8s v1helper.IsScalarResourceName: extended resources
+    (non-kubernetes.io domain-prefixed, not quota "requests.*" aliases),
+    hugepages, and attachable volume counts."""
+    if name.startswith("hugepages-") or name.startswith("attachable-volumes-"):
+        return True
+    if name.startswith("requests."):
+        return False
+    if "/" in name:
+        return name.split("/", 1)[0] != "kubernetes.io"
+    return False
 
 
 class Resource:

@@ -7,6 +7,21 @@ from typing import Dict, List
 
 ALL_NODE_UNAVAILABLE = "all nodes are unavailable"
 
+# (unschedule_info.go:14-15)
+NODE_RESOURCE_FIT_FAILED = "node(s) resource fit failed"
+
+
+class FitFailure(Exception):
+    """Raised by predicate fns when a task cannot fit a node; carries the
+    failure reasons (the error-return analog of api.PredicateFn)."""
+
+    def __init__(self, *reasons: str):
+        super().__init__(", ".join(reasons))
+        self.reasons = list(reasons)
+
+    def fit_error(self, task, node) -> "FitError":
+        return FitError(task, node, *self.reasons)
+
 
 class FitError:
     """Why one task failed to fit on one node (unschedule_info.go:82)."""

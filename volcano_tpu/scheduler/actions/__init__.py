@@ -1,0 +1,4 @@
+"""Scheduling actions, run in configured order each session
+(volcano pkg/scheduler/actions)."""
+
+from volcano_tpu.scheduler.actions import factory  # noqa: F401  (registers all)

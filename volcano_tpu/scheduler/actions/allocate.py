@@ -1,0 +1,148 @@
+"""allocate — the primary placement action
+(volcano pkg/scheduler/actions/allocate/allocate.go:42-247).
+
+Stages: namespace PQ -> queue (linear scan with Overused filter) -> job PQ ->
+task PQ -> predicate -> prioritize -> best node -> Allocate (fits idle) or
+Pipeline (fits releasing); per-job Statement committed only when the gang is
+JobReady, else discarded.
+
+This serial loop is the parity oracle; the ``tpuscore`` plugin swaps the
+per-task sweep for a batched TPU solve (volcano_tpu.ops) behind the same
+Statement/commit gate.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.job_info import JobInfo, TaskInfo
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.api.unschedule_info import NODE_RESOURCE_FIT_FAILED, FitFailure
+from volcano_tpu.scheduler.framework.interface import Action
+from volcano_tpu.scheduler.util import scheduler_helper as helper
+from volcano_tpu.scheduler.util.priority_queue import PriorityQueue
+
+logger = logging.getLogger(__name__)
+
+
+class AllocateAction(Action):
+    def name(self) -> str:
+        return "allocate"
+
+    def execute(self, ssn) -> None:
+        # TPU backend hook: if the tpuscore plugin attached a batch solver to
+        # this session, let it drive placement for the whole snapshot; the
+        # serial loop below remains the fallback and oracle.
+        solver = getattr(ssn, "batch_allocator", None)
+        if solver is not None:
+            solver(ssn)
+            return
+        self._serial_execute(ssn)
+
+    def _serial_execute(self, ssn) -> None:
+        namespaces = PriorityQueue(ssn.namespace_order_fn)
+        # namespace -> queue -> job PQ
+        jobs_map: Dict[str, Dict[str, PriorityQueue]] = {}
+
+        for job in ssn.jobs.values():
+            if job.pod_group.status.phase == objects.PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.pass_:
+                continue
+            if job.queue not in ssn.queues:
+                logger.warning(
+                    "Skip adding Job <%s/%s>: queue %s not found",
+                    job.namespace, job.name, job.queue)
+                continue
+            queue_map = jobs_map.get(job.namespace)
+            if queue_map is None:
+                namespaces.push(job.namespace)
+                queue_map = jobs_map[job.namespace] = {}
+            if job.queue not in queue_map:
+                queue_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+            queue_map[job.queue].push(job)
+
+        pending_tasks: Dict[str, PriorityQueue] = {}
+        all_nodes = helper.get_node_list(ssn.nodes)
+
+        def predicate_fn(task: TaskInfo, node) -> None:
+            # resource fit against idle OR releasing, then plugin chain
+            # (allocate.go:103-117)
+            if not task.init_resreq.less_equal(node.idle) and not task.init_resreq.less_equal(node.releasing):
+                raise FitFailure(NODE_RESOURCE_FIT_FAILED)
+            ssn.predicate_fn(task, node)
+
+        while not namespaces.empty():
+            namespace = namespaces.pop()
+            queue_in_namespace = jobs_map[namespace]
+
+            # linear queue scan with overused filter (allocate.go:134-146)
+            queue = None
+            for queue_id in list(queue_in_namespace):
+                current = ssn.queues[queue_id]
+                if ssn.overused(current):
+                    del queue_in_namespace[queue_id]
+                    continue
+                if queue is None or ssn.queue_order_fn(current, queue):
+                    queue = current
+            if queue is None:
+                continue
+
+            jobs = queue_in_namespace.get(queue.uid)
+            if jobs is None or jobs.empty():
+                continue
+
+            job: JobInfo = jobs.pop()
+            if job.uid not in pending_tasks:
+                tasks = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+                    if task.resreq.is_empty():
+                        continue  # BestEffort handled by backfill
+                    tasks.push(task)
+                pending_tasks[job.uid] = tasks
+            tasks = pending_tasks[job.uid]
+
+            stmt = ssn.statement()
+
+            while not tasks.empty():
+                task: TaskInfo = tasks.pop()
+
+                if job.nodes_fit_delta:
+                    job.nodes_fit_delta = {}
+
+                found_nodes, fit_errors = helper.predicate_nodes(task, all_nodes, predicate_fn)
+                if not found_nodes:
+                    job.nodes_fit_errors[task.uid] = fit_errors
+                    break
+
+                node_scores = helper.prioritize_nodes(
+                    task, found_nodes,
+                    ssn.batch_node_order_fn, ssn.node_order_map_fn, ssn.node_order_reduce_fn)
+                node = helper.select_best_node(node_scores)
+
+                if task.init_resreq.less_equal(node.idle):
+                    try:
+                        stmt.allocate(task, node.name)
+                    except (KeyError, RuntimeError) as e:
+                        logger.error("Failed to bind Task %s on %s: %s", task.uid, node.name, e)
+                else:
+                    # record the shortfall, then try releasing resources
+                    delta = node.idle.clone()
+                    delta.fit_delta(task.init_resreq)
+                    job.nodes_fit_delta[node.name] = delta
+                    if task.init_resreq.less_equal(node.releasing):
+                        stmt.pipeline(task, node.name)
+
+                if ssn.job_ready(job):
+                    jobs.push(job)
+                    break
+
+            if ssn.job_ready(job):
+                stmt.commit()
+            else:
+                stmt.discard()
+
+            namespaces.push(namespace)

@@ -1,0 +1,49 @@
+"""backfill — place BestEffort (zero-request) tasks on the first
+predicate-passing node, without scoring or statements
+(volcano pkg/scheduler/actions/backfill/backfill.go:41-91)."""
+
+from __future__ import annotations
+
+import logging
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.api.unschedule_info import FitErrors, FitFailure
+from volcano_tpu.scheduler.framework.interface import Action
+from volcano_tpu.scheduler.util import scheduler_helper as helper
+
+logger = logging.getLogger(__name__)
+
+
+class BackfillAction(Action):
+    def name(self) -> str:
+        return "backfill"
+
+    def execute(self, ssn) -> None:
+        for job in list(ssn.jobs.values()):
+            if job.pod_group.status.phase == objects.PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.pass_:
+                continue
+
+            for task in list(job.task_status_index.get(TaskStatus.PENDING, {}).values()):
+                if not task.init_resreq.is_empty():
+                    continue
+                allocated = False
+                fe = FitErrors()
+                for node in helper.get_node_list(ssn.nodes):
+                    try:
+                        ssn.predicate_fn(task, node)
+                    except FitFailure as err:
+                        fe.set_node_error(node.name, err.fit_error(task, node))
+                        continue
+                    try:
+                        ssn.allocate(task, node.name)
+                    except (KeyError, RuntimeError) as err:
+                        logger.error("Failed to bind Task %s on %s: %s", task.uid, node.name, err)
+                        continue
+                    allocated = True
+                    break
+                if not allocated:
+                    job.nodes_fit_errors[task.uid] = fe

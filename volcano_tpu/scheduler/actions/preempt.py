@@ -1,0 +1,170 @@
+"""preempt — within-queue job-vs-job, then intra-job task-vs-task preemption
+(volcano pkg/scheduler/actions/preempt/preempt.go:45-277).
+
+Victims come from the tiered ``ssn.preemptable`` intersection; lowest-priority
+victims are evicted until the preemptor fits; the preemptor is Pipelined onto
+the node. The per-job Statement commits when JobPipelined holds.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.framework.interface import Action
+from volcano_tpu.scheduler.util import scheduler_helper as helper
+from volcano_tpu.scheduler.util.priority_queue import PriorityQueue
+
+logger = logging.getLogger(__name__)
+
+
+class PreemptAction(Action):
+    def name(self) -> str:
+        return "preempt"
+
+    def execute(self, ssn) -> None:
+        preemptors_map: Dict[str, PriorityQueue] = {}
+        preemptor_tasks: Dict[str, PriorityQueue] = {}
+        under_request: List = []
+        queues: Dict[str, object] = {}
+
+        for job in ssn.jobs.values():
+            if job.pod_group.status.phase == objects.PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.pass_:
+                continue
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            queues.setdefault(queue.uid, queue)
+
+            if job.task_status_index.get(TaskStatus.PENDING):
+                if job.queue not in preemptors_map:
+                    preemptors_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+                preemptors_map[job.queue].push(job)
+                under_request.append(job)
+                preemptor_tasks[job.uid] = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index[TaskStatus.PENDING].values():
+                    preemptor_tasks[job.uid].push(task)
+
+        for queue in queues.values():
+            # Preemption between jobs within the queue.
+            while True:
+                preemptors = preemptors_map.get(queue.uid)
+                if preemptors is None or preemptors.empty():
+                    break
+                preemptor_job = preemptors.pop()
+
+                stmt = ssn.statement()
+                assigned = False
+                while True:
+                    if preemptor_tasks[preemptor_job.uid].empty():
+                        break
+                    preemptor = preemptor_tasks[preemptor_job.uid].pop()
+
+                    def job_filter(task, _preemptor=preemptor, _job=preemptor_job):
+                        if task.status != TaskStatus.RUNNING:
+                            return False
+                        job = ssn.jobs.get(task.job)
+                        if job is None:
+                            return False
+                        return job.queue == _job.queue and _preemptor.job != task.job
+
+                    if _preempt(ssn, stmt, preemptor, ssn.nodes, job_filter):
+                        assigned = True
+
+                    if ssn.job_pipelined(preemptor_job):
+                        stmt.commit()
+                        break
+
+                if not ssn.job_pipelined(preemptor_job):
+                    stmt.discard()
+                    continue
+
+                if assigned:
+                    preemptors.push(preemptor_job)
+
+            # Preemption between tasks within one job.
+            for job in under_request:
+                while True:
+                    tasks = preemptor_tasks.get(job.uid)
+                    if tasks is None or tasks.empty():
+                        break
+                    preemptor = tasks.pop()
+
+                    def task_filter(task, _preemptor=preemptor):
+                        if task.status != TaskStatus.RUNNING:
+                            return False
+                        return _preemptor.job == task.job
+
+                    stmt = ssn.statement()
+                    assigned = _preempt(ssn, stmt, preemptor, ssn.nodes, task_filter)
+                    stmt.commit()
+                    if not assigned:
+                        break
+
+
+def _preempt(ssn, stmt, preemptor, nodes, task_filter) -> bool:
+    """(preempt.go:180-260)"""
+    assigned = False
+    all_nodes = helper.get_node_list(nodes)
+    found_nodes, _ = helper.predicate_nodes(preemptor, all_nodes, ssn.predicate_fn)
+    node_scores = helper.prioritize_nodes(
+        preemptor, found_nodes,
+        ssn.batch_node_order_fn, ssn.node_order_map_fn, ssn.node_order_reduce_fn)
+
+    for node in helper.sort_nodes(node_scores):
+        preemptees = [
+            task.clone()
+            for task in node.tasks.values()
+            if task_filter is None or task_filter(task)
+        ]
+        victims = ssn.preemptable(preemptor, preemptees)
+        metrics.update_preemption_victims(len(victims))
+
+        if not _validate_victims(victims, preemptor.init_resreq):
+            continue
+
+        preempted = Resource.empty()
+        resreq = preemptor.init_resreq.clone()
+
+        # lowest-priority victims first (inverse task order)
+        victims_queue = PriorityQueue(lambda l, r: not ssn.task_order_fn(l, r))
+        for victim in victims:
+            victims_queue.push(victim)
+        while not victims_queue.empty():
+            preemptee = victims_queue.pop()
+            try:
+                stmt.evict(preemptee, "preempt")
+            except Exception as e:
+                logger.error("Failed to preempt Task <%s/%s> for <%s/%s>: %s",
+                             preemptee.namespace, preemptee.name,
+                             preemptor.namespace, preemptor.name, e)
+                continue
+            preempted.add(preemptee.resreq)
+            if resreq.less_equal(preempted):
+                break
+
+        metrics.register_preemption_attempts()
+
+        if preemptor.init_resreq.less_equal(preempted):
+            stmt.pipeline(preemptor, node.name)
+            assigned = True
+            break
+
+    return assigned
+
+
+def _validate_victims(victims, resreq) -> bool:
+    """(preempt.go:262-277)"""
+    if not victims:
+        return False
+    all_res = Resource.empty()
+    for v in victims:
+        all_res.add(v.resreq)
+    return not all_res.less(resreq)

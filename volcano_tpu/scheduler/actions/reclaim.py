@@ -1,0 +1,117 @@
+"""reclaim — cross-queue reclamation for starved queues
+(volcano pkg/scheduler/actions/reclaim/reclaim.go:42-205).
+
+A non-overused queue's pending job evicts Running tasks from *other* queues
+(via the tiered ``ssn.reclaimable`` intersection — the proportion plugin
+enforces the deserved-share floor) and pipelines the reclaimer. Direct
+``ssn.evict``/``ssn.pipeline``, no statement.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.api.unschedule_info import FitFailure
+from volcano_tpu.scheduler.framework.interface import Action
+from volcano_tpu.scheduler.util import scheduler_helper as helper
+from volcano_tpu.scheduler.util.priority_queue import PriorityQueue
+
+logger = logging.getLogger(__name__)
+
+
+class ReclaimAction(Action):
+    def name(self) -> str:
+        return "reclaim"
+
+    def execute(self, ssn) -> None:
+        queues = PriorityQueue(ssn.queue_order_fn)
+        queue_set = set()
+        preemptors_map: Dict[str, PriorityQueue] = {}
+        preemptor_tasks: Dict[str, PriorityQueue] = {}
+
+        for job in ssn.jobs.values():
+            if job.pod_group.status.phase == objects.PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.pass_:
+                continue
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            if queue.uid not in queue_set:
+                queue_set.add(queue.uid)
+                queues.push(queue)
+            if job.task_status_index.get(TaskStatus.PENDING):
+                if job.queue not in preemptors_map:
+                    preemptors_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+                preemptors_map[job.queue].push(job)
+                preemptor_tasks[job.uid] = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index[TaskStatus.PENDING].values():
+                    preemptor_tasks[job.uid].push(task)
+
+        while not queues.empty():
+            queue = queues.pop()
+            if ssn.overused(queue):
+                continue
+
+            jobs = preemptors_map.get(queue.uid)
+            if jobs is None or jobs.empty():
+                continue
+            job = jobs.pop()
+
+            tasks = preemptor_tasks.get(job.uid)
+            if tasks is None or tasks.empty():
+                continue
+            task = tasks.pop()
+
+            assigned = False
+            for node in helper.get_node_list(ssn.nodes):
+                try:
+                    ssn.predicate_fn(task, node)
+                except FitFailure:
+                    continue
+
+                resreq = task.init_resreq.clone()
+                reclaimed = Resource.empty()
+
+                reclaimees: List = []
+                for t in node.tasks.values():
+                    if t.status != TaskStatus.RUNNING:
+                        continue
+                    j = ssn.jobs.get(t.job)
+                    if j is None:
+                        continue
+                    if j.queue != job.queue:
+                        reclaimees.append(t.clone())
+                victims = ssn.reclaimable(task, reclaimees)
+                if not victims:
+                    continue
+
+                all_res = Resource.empty()
+                for v in victims:
+                    all_res.add(v.resreq)
+                if all_res.less(resreq):
+                    continue
+
+                for reclaimee in victims:
+                    try:
+                        ssn.evict(reclaimee, "reclaim")
+                    except (KeyError, RuntimeError) as e:
+                        logger.error("Failed to reclaim %s/%s: %s",
+                                     reclaimee.namespace, reclaimee.name, e)
+                        continue
+                    reclaimed.add(reclaimee.resreq)
+                    if resreq.less_equal(reclaimed):
+                        break
+
+                if task.init_resreq.less_equal(reclaimed):
+                    ssn.pipeline(task, node.name)
+                    assigned = True
+                    break
+
+            if assigned:
+                queues.push(queue)

@@ -1,0 +1,15 @@
+"""Scheduler cache: the cluster mirror + effector seam."""
+
+from volcano_tpu.scheduler.cache.interface import (
+    Binder,
+    Evictor,
+    StatusUpdater,
+    VolumeBinder,
+)
+from volcano_tpu.scheduler.cache.cache import (
+    SchedulerCache,
+    DefaultBinder,
+    DefaultEvictor,
+    DefaultStatusUpdater,
+    DefaultVolumeBinder,
+)

@@ -1,0 +1,522 @@
+"""SchedulerCache — the cluster mirror the session snapshots from
+(volcano pkg/scheduler/cache/{cache.go,event_handlers.go}).
+
+Mirrors the store into JobInfo/NodeInfo/QueueInfo maps via watch streams,
+produces the per-session deep-clone ``snapshot()``, and owns the effector
+write-path (bind/evict/status) with resync-on-failure.
+
+Differences from the reference, by design:
+- watches are synchronous store callbacks, not informer goroutines, so
+  ``wait_for_cache_sync`` is trivially true and the whole cache is
+  deterministic (a property the replay benchmarks rely on);
+- bind/evict call the effector inline rather than in a goroutine; failures
+  feed the same ``resync`` path (cache.go:597-613 does this asynchronously).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.cluster_info import ClusterInfo
+from volcano_tpu.api.job_info import JobInfo, TaskInfo, new_task_info
+from volcano_tpu.api.namespace_info import NamespaceCollection
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.queue_info import QueueInfo
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.api.unschedule_info import ALL_NODE_UNAVAILABLE
+from volcano_tpu.store import NotFoundError, Store, WatchHandler
+
+
+def _is_terminated(status: TaskStatus) -> bool:
+    return status in (TaskStatus.SUCCEEDED, TaskStatus.FAILED)
+
+
+def pod_group_job_id(pg: objects.PodGroup) -> str:
+    return f"{pg.metadata.namespace}/{pg.metadata.name}"
+
+
+# ---------------------------------------------------------------------------
+# Default effectors (write back to the store; cache.go:123-260)
+# ---------------------------------------------------------------------------
+
+
+class DefaultBinder:
+    """Commit placement by setting spec.node_name (the Bind subresource)."""
+
+    def __init__(self, store: Store):
+        self.store = store
+
+    def bind(self, pod: objects.Pod, hostname: str) -> None:
+        pod.spec.node_name = hostname
+        self.store.update(pod)
+
+
+class DefaultEvictor:
+    """Graceful deletion: stamp deletion_timestamp; the kubelet analog
+    completes the termination."""
+
+    def __init__(self, store: Store):
+        self.store = store
+
+    def evict(self, pod: objects.Pod, reason: str = "") -> None:
+        import time as _time
+
+        pod.metadata.deletion_timestamp = _time.time()
+        self.store.update(pod)
+
+
+class DefaultStatusUpdater:
+    def __init__(self, store: Store):
+        self.store = store
+
+    def update_pod_condition(self, pod: objects.Pod, condition) -> None:
+        for i, c in enumerate(pod.status.conditions):
+            if c.type == condition.type:
+                pod.status.conditions[i] = condition
+                break
+        else:
+            pod.status.conditions.append(condition)
+        self.store.update(pod)
+
+    def update_pod_group(self, pod_group: objects.PodGroup, status=None) -> None:
+        if status is not None:
+            pod_group.status = status
+        self.store.update_status(pod_group)
+
+
+class DefaultVolumeBinder:
+    """PVC assume/bind analog; volumes are considered host-agnostic here."""
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        task.volume_ready = True
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# The cache
+# ---------------------------------------------------------------------------
+
+
+class SchedulerCache:
+    def __init__(
+        self,
+        store: Optional[Store] = None,
+        scheduler_name: str = "volcano",
+        default_queue: str = "default",
+        binder=None,
+        evictor=None,
+        status_updater=None,
+        volume_binder=None,
+    ):
+        self.store = store
+        self.scheduler_name = scheduler_name
+        self.default_queue = default_queue
+
+        self.binder = binder if binder is not None else (DefaultBinder(store) if store else None)
+        self.evictor = evictor if evictor is not None else (DefaultEvictor(store) if store else None)
+        self.status_updater = (
+            status_updater if status_updater is not None else (DefaultStatusUpdater(store) if store else None)
+        )
+        self.volume_binder = volume_binder if volume_binder is not None else DefaultVolumeBinder()
+
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.priority_classes: Dict[str, objects.PriorityClass] = {}
+        self.default_priority = 0
+        self.namespace_collection: Dict[str, NamespaceCollection] = {}
+
+        self._lock = threading.RLock()
+        self._err_tasks: List[TaskInfo] = []
+        self._deleted_jobs: List[JobInfo] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self) -> None:
+        """Wire the 11-informer equivalent: watch every kind the scheduler
+        consumes (cache.go:322-425)."""
+        if self.store is None:
+            return
+        s = self.store
+        s.watch("Pod", WatchHandler(self.add_pod, self.update_pod_from_watch, self.delete_pod))
+        s.watch("Node", WatchHandler(self.add_node, self.update_node_from_watch, self.delete_node))
+        s.watch("PodGroup", WatchHandler(self.add_pod_group, self.update_pod_group_from_watch, self.delete_pod_group))
+        s.watch("Queue", WatchHandler(self.add_queue, self.update_queue_from_watch, self.delete_queue))
+        s.watch("PriorityClass", WatchHandler(self.add_priority_class, self.update_priority_class_from_watch, self.delete_priority_class))
+        s.watch("ResourceQuota", WatchHandler(self.add_resource_quota, self.update_resource_quota_from_watch, self.delete_resource_quota))
+        s.watch("PodDisruptionBudget", WatchHandler(self.add_pdb, self.update_pdb_from_watch, self.delete_pdb))
+
+    def wait_for_cache_sync(self) -> bool:
+        return True  # synchronous watches are always synced
+
+    # -- pod/task handlers (event_handlers.go:39-200) ----------------------
+
+    def _get_or_create_job(self, ti: TaskInfo) -> Optional[JobInfo]:
+        if not ti.job:
+            return None
+        if ti.job not in self.jobs:
+            self.jobs[ti.job] = JobInfo(ti.job)
+        return self.jobs[ti.job]
+
+    def _add_task(self, ti: TaskInfo) -> None:
+        job = self._get_or_create_job(ti)
+        if job is not None:
+            job.add_task_info(ti)
+        if ti.node_name:
+            if ti.node_name not in self.nodes:
+                self.nodes[ti.node_name] = NodeInfo(None)
+            if not _is_terminated(ti.status):
+                self.nodes[ti.node_name].add_task(ti)
+
+    def _delete_task(self, ti: TaskInfo) -> None:
+        errs = []
+        if ti.job:
+            job = self.jobs.get(ti.job)
+            if job is not None:
+                try:
+                    job.delete_task_info(ti)
+                except KeyError as e:
+                    errs.append(e)
+            else:
+                errs.append(KeyError(f"failed to find Job {ti.job} for task {ti.namespace}/{ti.name}"))
+        if ti.node_name:
+            node = self.nodes.get(ti.node_name)
+            if node is not None:
+                try:
+                    node.remove_task(ti)
+                except RuntimeError as e:
+                    errs.append(e)
+        if errs:
+            raise RuntimeError("; ".join(str(e) for e in errs))
+
+    def _responsible_for(self, pod: objects.Pod) -> bool:
+        """Informer filter (cache.go:352-361): our pods, plus ANY bound pod —
+        foreign bound pods must still count against node resources."""
+        return (
+            pod.spec.scheduler_name == self.scheduler_name
+            or bool(pod.metadata.annotations.get(objects.GROUP_NAME_ANNOTATION_KEY))
+            or bool(pod.spec.node_name)
+        )
+
+    def add_pod(self, pod: objects.Pod) -> None:
+        with self._lock:
+            if not self._responsible_for(pod):
+                return
+            self._add_task(new_task_info(pod))
+
+    def update_pod_from_watch(self, old_pod: objects.Pod, new_pod: objects.Pod) -> None:
+        with self._lock:
+            self._delete_pod_locked(old_pod)
+            if not self._responsible_for(new_pod):
+                return
+            self._add_task(new_task_info(new_pod))
+
+    def _delete_pod_locked(self, pod: objects.Pod) -> None:
+        pi = new_task_info(pod)
+        # Prefer the cached task (it may be in Binding status; event_handlers.go:154-161)
+        task = pi
+        job = self.jobs.get(pi.job)
+        if job is not None and pi.uid in job.tasks:
+            task = job.tasks[pi.uid]
+        try:
+            self._delete_task(task)
+        except RuntimeError:
+            pass
+        if job is not None and job.is_terminated():
+            self._delete_job(job)
+
+    def delete_pod(self, pod: objects.Pod) -> None:
+        with self._lock:
+            self._delete_pod_locked(pod)
+
+    # -- node handlers -----------------------------------------------------
+
+    def add_node(self, node: objects.Node) -> None:
+        with self._lock:
+            if node.metadata.name in self.nodes:
+                self.nodes[node.metadata.name].set_node(node)
+            else:
+                self.nodes[node.metadata.name] = NodeInfo(node)
+
+    def update_node_from_watch(self, old: objects.Node, new: objects.Node) -> None:
+        self.add_node(new)
+
+    def delete_node(self, node: objects.Node) -> None:
+        with self._lock:
+            self.nodes.pop(node.metadata.name, None)
+
+    # -- podgroup handlers (event_handlers.go:159-196) ---------------------
+
+    def add_pod_group(self, pg: objects.PodGroup) -> None:
+        with self._lock:
+            job_id = pod_group_job_id(pg)
+            if job_id not in self.jobs:
+                self.jobs[job_id] = JobInfo(job_id)
+            job = self.jobs[job_id]
+            job.set_pod_group(pg)
+            if not job.queue:
+                job.queue = self.default_queue
+
+    def update_pod_group_from_watch(self, old: objects.PodGroup, new: objects.PodGroup) -> None:
+        self.add_pod_group(new)
+
+    def delete_pod_group(self, pg: objects.PodGroup) -> None:
+        with self._lock:
+            job_id = pod_group_job_id(pg)
+            job = self.jobs.get(job_id)
+            if job is None:
+                return
+            job.unset_pod_group()
+            self._delete_job(job)
+
+    # -- queue handlers ----------------------------------------------------
+
+    def add_queue(self, queue: objects.Queue) -> None:
+        with self._lock:
+            self.queues[queue.metadata.name] = QueueInfo(queue)
+
+    def update_queue_from_watch(self, old: objects.Queue, new: objects.Queue) -> None:
+        self.add_queue(new)
+
+    def delete_queue(self, queue: objects.Queue) -> None:
+        with self._lock:
+            self.queues.pop(queue.metadata.name, None)
+
+    # -- priority class handlers (event_handlers.go) -----------------------
+
+    def add_priority_class(self, pc: objects.PriorityClass) -> None:
+        with self._lock:
+            self.priority_classes[pc.metadata.name] = pc
+            if pc.global_default:
+                self.default_priority = pc.value
+
+    def update_priority_class_from_watch(self, old, new) -> None:
+        self.add_priority_class(new)
+
+    def delete_priority_class(self, pc: objects.PriorityClass) -> None:
+        with self._lock:
+            self.priority_classes.pop(pc.metadata.name, None)
+            if pc.global_default:
+                self.default_priority = 0
+
+    # -- resource quota handlers (namespace weights) -----------------------
+
+    def add_resource_quota(self, quota: objects.ResourceQuota) -> None:
+        with self._lock:
+            ns = quota.metadata.namespace
+            coll = self.namespace_collection.setdefault(ns, NamespaceCollection(ns))
+            coll.update(quota)
+
+    def update_resource_quota_from_watch(self, old, new) -> None:
+        self.add_resource_quota(new)
+
+    def delete_resource_quota(self, quota: objects.ResourceQuota) -> None:
+        with self._lock:
+            coll = self.namespace_collection.get(quota.metadata.namespace)
+            if coll is not None:
+                coll.delete(quota)
+                if coll.empty():
+                    del self.namespace_collection[quota.metadata.namespace]
+
+    # -- pdb handlers ------------------------------------------------------
+
+    def add_pdb(self, pdb: objects.PodDisruptionBudget) -> None:
+        with self._lock:
+            job_id = f"{pdb.metadata.namespace}/{pdb.metadata.name}"
+            if job_id not in self.jobs:
+                self.jobs[job_id] = JobInfo(job_id)
+            self.jobs[job_id].set_pdb(pdb)
+
+    def update_pdb_from_watch(self, old, new) -> None:
+        self.add_pdb(new)
+
+    def delete_pdb(self, pdb: objects.PodDisruptionBudget) -> None:
+        with self._lock:
+            job_id = f"{pdb.metadata.namespace}/{pdb.metadata.name}"
+            job = self.jobs.get(job_id)
+            if job is None:
+                return
+            job.unset_pdb()
+            self._delete_job(job)
+
+    # -- job cleanup (cache.go:656-688) ------------------------------------
+
+    def _delete_job(self, job: JobInfo) -> None:
+        self._deleted_jobs.append(job)
+        self._process_cleanup_jobs()
+
+    def _process_cleanup_jobs(self) -> None:
+        remaining = []
+        for job in self._deleted_jobs:
+            if job.is_terminated():
+                self.jobs.pop(job.uid, None)
+            else:
+                remaining.append(job)
+        self._deleted_jobs = remaining
+
+    # -- effector path (cache.go:499-613) ----------------------------------
+
+    def _find_job_and_task(self, task_info: TaskInfo):
+        job = self.jobs.get(task_info.job)
+        if job is None:
+            raise KeyError(f"failed to find Job {task_info.job} for Task {task_info.uid}")
+        task = job.tasks.get(task_info.uid)
+        if task is None:
+            raise KeyError(f"failed to find task in status {task_info.status} by id {task_info.uid}")
+        return job, task
+
+    def bind(self, task_info: TaskInfo, hostname: str) -> None:
+        """Update cache state to Binding and invoke the binder; on binder
+        failure, queue the task for resync (cache.go:558-613)."""
+        with self._lock:
+            job, task = self._find_job_and_task(task_info)
+            node = self.nodes.get(hostname)
+            if node is None:
+                raise KeyError(f"failed to bind Task {task.uid} to host {hostname}: host does not exist")
+            job.update_task_status(task, TaskStatus.BINDING)
+            task.node_name = hostname
+            node.add_task(task)
+            pod = task.pod
+        try:
+            self.binder.bind(pod, hostname)
+        except Exception:
+            self.resync_task(task)
+        else:
+            if self.store is not None:
+                self.store.record_event(
+                    pod, "Normal", "Scheduled",
+                    f"Successfully assigned {pod.metadata.namespace}/{pod.metadata.name} to {hostname}",
+                )
+
+    def evict(self, task_info: TaskInfo, reason: str) -> None:
+        with self._lock:
+            job, task = self._find_job_and_task(task_info)
+            node = self.nodes.get(task.node_name)
+            if node is None:
+                raise KeyError(f"failed to evict Task {task.uid}: host {task.node_name} does not exist")
+            job.update_task_status(task, TaskStatus.RELEASING)
+            node.update_task(task)
+            pod = task.pod
+        try:
+            self.evictor.evict(pod, reason)
+        except Exception:
+            self.resync_task(task)
+        else:
+            if self.store is not None:
+                self.store.record_event(pod, "Normal", "Evict", reason)
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        self.volume_binder.allocate_volumes(task, hostname)
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        self.volume_binder.bind_volumes(task)
+
+    # -- resync (cache.go:688-710, event_handlers.go:88-105) ---------------
+
+    def resync_task(self, task: TaskInfo) -> None:
+        self._err_tasks.append(task)
+
+    def process_resync_tasks(self) -> None:
+        """Re-fetch truth from the store for tasks whose effector failed."""
+        tasks, self._err_tasks = self._err_tasks, []
+        for task in tasks:
+            try:
+                self.sync_task(task)
+            except Exception:
+                self._err_tasks.append(task)
+
+    def sync_task(self, old_task: TaskInfo) -> None:
+        if self.store is None:
+            return
+        try:
+            new_pod = self.store.get("Pod", old_task.namespace, old_task.name)
+        except NotFoundError:
+            with self._lock:
+                try:
+                    self._delete_task(old_task)
+                except RuntimeError:
+                    pass
+            return
+        with self._lock:
+            self._delete_task(old_task)
+            self._add_task(new_task_info(new_pod))
+
+    # -- status writeback (cache.go:832-895) -------------------------------
+
+    def task_unschedulable(self, task: TaskInfo, message: str) -> None:
+        """Record FailedScheduling + update the PodScheduled condition
+        (cache.go:629-655), deduping unchanged conditions."""
+        pod = task.pod
+        condition = objects.PodCondition(
+            type="PodScheduled", status="False", reason="Unschedulable", message=message
+        )
+        for c in pod.status.conditions:
+            if (
+                c.type == condition.type
+                and c.status == condition.status
+                and c.message == condition.message
+            ):
+                return  # no update needed
+        if self.store is not None:
+            self.store.record_event(pod, "Warning", "FailedScheduling", message)
+        if self.status_updater is not None:
+            self.status_updater.update_pod_condition(pod, condition)
+
+    def record_job_status_event(self, job: JobInfo) -> None:
+        """(cache.go:834-869)"""
+        base_msg = job.job_fit_errors or ALL_NODE_UNAVAILABLE
+        pg_unschedulable = job.pod_group is not None and job.pod_group.status.phase in (
+            objects.PodGroupPhase.UNKNOWN,
+            objects.PodGroupPhase.PENDING,
+            objects.PodGroupPhase.INQUEUE,
+        )
+        pdb_unschedulable = job.pdb is not None and bool(
+            job.task_status_index.get(TaskStatus.PENDING)
+        )
+        if (pg_unschedulable or pdb_unschedulable) and self.store is not None and job.pod_group is not None:
+            pending = len(job.task_status_index.get(TaskStatus.PENDING, {}))
+            msg = f"{pending}/{len(job.tasks)} tasks in gang unschedulable: {job.fit_error()}"
+            self.store.record_event(job.pod_group, "Warning", "Unschedulable", msg)
+
+        for status in (TaskStatus.ALLOCATED, TaskStatus.PENDING, TaskStatus.PIPELINED):
+            for task in job.task_status_index.get(status, {}).values():
+                fit_error = job.nodes_fit_errors.get(task.uid)
+                msg = fit_error.error() if fit_error is not None else base_msg
+                self.task_unschedulable(task, msg)
+
+    def update_job_status(self, job: JobInfo, update_pg: bool) -> JobInfo:
+        if update_pg and self.status_updater is not None and job.pod_group is not None:
+            self.status_updater.update_pod_group(job.pod_group)
+        self.record_job_status_event(job)
+        return job
+
+    # -- snapshot (cache.go:713-798) ---------------------------------------
+
+    def snapshot(self) -> ClusterInfo:
+        with self._lock:
+            snap = ClusterInfo()
+            for node in self.nodes.values():
+                if not node.ready():
+                    continue
+                snap.nodes[node.name] = node.clone()
+            for queue in self.queues.values():
+                snap.queues[queue.uid] = queue.clone()
+            for ns, coll in self.namespace_collection.items():
+                snap.namespace_info[ns] = coll.snapshot()
+            for job in self.jobs.values():
+                if job.pod_group is None and job.pdb is None:
+                    continue  # no scheduling spec
+                if job.queue not in snap.queues:
+                    continue  # queue doesn't exist
+                if job.pod_group is not None:
+                    job.priority = self.default_priority
+                    pri_name = job.pod_group.spec.priority_class_name
+                    pc = self.priority_classes.get(pri_name)
+                    if pc is not None:
+                        job.priority = pc.value
+                snap.jobs[job.uid] = job.clone()
+            return snap

@@ -1,0 +1,38 @@
+"""The effector seam of the scheduler cache
+(volcano pkg/scheduler/cache/interface.go:27-76).
+
+``Binder``/``Evictor``/``StatusUpdater``/``VolumeBinder`` are the pluggable
+write-paths from scheduler decisions back to the state store. Unit tests,
+the deterministic replay benchmark, and the TPU parity harness all plug
+fakes into exactly this seam.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Binder(Protocol):
+    def bind(self, pod, hostname: str) -> None:
+        """Commit a placement (the pods/{name}/binding POST analog)."""
+
+
+@runtime_checkable
+class Evictor(Protocol):
+    def evict(self, pod, reason: str = "") -> None:
+        """Start graceful deletion of a pod."""
+
+
+@runtime_checkable
+class StatusUpdater(Protocol):
+    def update_pod_condition(self, pod, condition) -> None: ...
+
+    def update_pod_group(self, pod_group, status=None) -> None: ...
+
+
+@runtime_checkable
+class VolumeBinder(Protocol):
+    def allocate_volumes(self, task, hostname: str) -> None: ...
+
+    def bind_volumes(self, task) -> None: ...

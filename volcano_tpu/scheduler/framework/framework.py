@@ -1,0 +1,61 @@
+"""Session lifecycle (volcano pkg/scheduler/framework/framework.go:30-62)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List
+
+from volcano_tpu.scheduler import conf
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.framework.arguments import Arguments
+from volcano_tpu.scheduler.framework.job_updater import JobUpdater
+from volcano_tpu.scheduler.framework.plugins import get_plugin_builder
+from volcano_tpu.scheduler.framework.session import Session, open_session_state
+
+logger = logging.getLogger(__name__)
+
+
+def open_session(cache, tiers: List[conf.Tier]) -> Session:
+    ssn = Session(cache)
+    # snapshot happens before tiers are installed (so the open-time JobValid
+    # pass is a no-op — actions re-validate; matches framework.go:31-32)
+    open_session_state(ssn)
+    # conf loading normally defaults the enable flags (util.go:59); defaulting
+    # again here is idempotent and protects hand-built tiers.
+    for tier in tiers:
+        for option in tier.plugins:
+            conf.apply_plugin_conf_defaults(option)
+    ssn.tiers = tiers
+
+    for tier in tiers:
+        for plugin_option in tier.plugins:
+            builder = get_plugin_builder(plugin_option.name)
+            if builder is None:
+                logger.error("Failed to get plugin %s.", plugin_option.name)
+                continue
+            plugin = builder(Arguments(plugin_option.arguments))
+            ssn.plugins[plugin.name()] = plugin
+
+    for plugin in ssn.plugins.values():
+        start = time.perf_counter()
+        plugin.on_session_open(ssn)
+        metrics.update_plugin_duration(plugin.name(), "OnSessionOpen", time.perf_counter() - start)
+    return ssn
+
+
+def close_session(ssn: Session) -> None:
+    for plugin in ssn.plugins.values():
+        start = time.perf_counter()
+        plugin.on_session_close(ssn)
+        metrics.update_plugin_duration(plugin.name(), "OnSessionClose", time.perf_counter() - start)
+
+    JobUpdater(ssn).update_all()
+
+    ssn.jobs = {}
+    ssn.nodes = {}
+    ssn.plugins = {}
+    ssn.event_handlers = []
+    ssn.job_order_fns = {}
+    ssn.namespace_order_fns = {}
+    ssn.queue_order_fns = {}

@@ -1,0 +1,436 @@
+"""Session — per-cycle snapshot + plugin extension points + mutation API
+(volcano pkg/scheduler/framework/{session.go,session_plugins.go}).
+
+Tiered dispatch semantics (session_plugins.go:106-523), preserved exactly:
+- victim fns (preemptable/reclaimable): INTERSECTION within a tier; the first
+  tier that produces a non-None result decides;
+- order fns (job/queue/task/namespace): first non-zero comparison across
+  tiers wins; creation-timestamp+UID tie-break as default;
+- job_ready/job_pipelined: AND across all enabled plugins;
+- overused: OR;
+- job_valid/job_enqueueable: first failure rejects;
+- node order: SUM of scores across plugins; batch node order sums per-node.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.cluster_info import ClusterInfo
+from volcano_tpu.api.job_info import JobInfo, TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.queue_info import QueueInfo
+from volcano_tpu.api.types import TaskStatus, allocated_status
+from volcano_tpu.scheduler import conf
+from volcano_tpu.scheduler.framework.event_handlers import Event, EventHandler
+
+
+class Session:
+    def __init__(self, cache):
+        self.uid = str(uuid.uuid4())
+        self.cache = cache
+
+        self.pod_group_status: Dict[str, objects.PodGroupStatus] = {}
+
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.namespace_info: Dict[str, object] = {}
+
+        self.tiers: List[conf.Tier] = []
+        self.plugins: Dict[str, object] = {}
+
+        self.event_handlers: List[EventHandler] = []
+        self.job_order_fns: Dict[str, Callable] = {}
+        self.queue_order_fns: Dict[str, Callable] = {}
+        self.task_order_fns: Dict[str, Callable] = {}
+        self.namespace_order_fns: Dict[str, Callable] = {}
+        self.predicate_fns: Dict[str, Callable] = {}
+        self.node_order_fns: Dict[str, Callable] = {}
+        self.batch_node_order_fns: Dict[str, Callable] = {}
+        self.node_map_fns: Dict[str, Callable] = {}
+        self.node_reduce_fns: Dict[str, Callable] = {}
+        self.preemptable_fns: Dict[str, Callable] = {}
+        self.reclaimable_fns: Dict[str, Callable] = {}
+        self.overused_fns: Dict[str, Callable] = {}
+        self.job_ready_fns: Dict[str, Callable] = {}
+        self.job_pipelined_fns: Dict[str, Callable] = {}
+        self.job_valid_fns: Dict[str, Callable] = {}
+        self.job_enqueueable_fns: Dict[str, Callable] = {}
+
+    # ------------------------------------------------------------------
+    # registration (session_plugins.go:26-104)
+    # ------------------------------------------------------------------
+
+    def add_job_order_fn(self, name: str, fn) -> None:
+        """fn(l_job, r_job) -> int (-1/0/1)"""
+        self.job_order_fns[name] = fn
+
+    def add_queue_order_fn(self, name: str, fn) -> None:
+        self.queue_order_fns[name] = fn
+
+    def add_task_order_fn(self, name: str, fn) -> None:
+        self.task_order_fns[name] = fn
+
+    def add_namespace_order_fn(self, name: str, fn) -> None:
+        self.namespace_order_fns[name] = fn
+
+    def add_preemptable_fn(self, name: str, fn) -> None:
+        """fn(preemptor: TaskInfo, preemptees: [TaskInfo]) -> [TaskInfo]"""
+        self.preemptable_fns[name] = fn
+
+    def add_reclaimable_fn(self, name: str, fn) -> None:
+        self.reclaimable_fns[name] = fn
+
+    def add_job_ready_fn(self, name: str, fn) -> None:
+        """fn(job) -> bool"""
+        self.job_ready_fns[name] = fn
+
+    def add_job_pipelined_fn(self, name: str, fn) -> None:
+        self.job_pipelined_fns[name] = fn
+
+    def add_predicate_fn(self, name: str, fn) -> None:
+        """fn(task, node) -> None, raising FitFailure on mismatch"""
+        self.predicate_fns[name] = fn
+
+    def add_node_order_fn(self, name: str, fn) -> None:
+        """fn(task, node) -> float"""
+        self.node_order_fns[name] = fn
+
+    def add_batch_node_order_fn(self, name: str, fn) -> None:
+        """fn(task, nodes) -> {node_name: float}"""
+        self.batch_node_order_fns[name] = fn
+
+    def add_node_map_fn(self, name: str, fn) -> None:
+        self.node_map_fns[name] = fn
+
+    def add_node_reduce_fn(self, name: str, fn) -> None:
+        self.node_reduce_fns[name] = fn
+
+    def add_overused_fn(self, name: str, fn) -> None:
+        self.overused_fns[name] = fn
+
+    def add_job_valid_fn(self, name: str, fn) -> None:
+        """fn(job) -> Optional[ValidateResult]"""
+        self.job_valid_fns[name] = fn
+
+    def add_job_enqueueable_fn(self, name: str, fn) -> None:
+        self.job_enqueueable_fns[name] = fn
+
+    def add_event_handler(self, eh: EventHandler) -> None:
+        self.event_handlers.append(eh)
+
+    # ------------------------------------------------------------------
+    # tiered dispatch
+    # ------------------------------------------------------------------
+
+    def _tier_plugins(self, flag_name: Optional[str], fns: Dict[str, Callable]):
+        """Yield (tier, enabled fns in tier order)."""
+        for tier in self.tiers:
+            out = []
+            for plugin in tier.plugins:
+                if flag_name is not None and not conf.enabled(getattr(plugin, flag_name)):
+                    continue
+                fn = fns.get(plugin.name)
+                if fn is not None:
+                    out.append(fn)
+            yield out
+
+    def _victims(self, flag_name: str, fns, claimer, claimees) -> List[TaskInfo]:
+        """Within-tier intersection; first deciding tier wins
+        (session_plugins.go:106-187)."""
+        for tier_fns in self._tier_plugins(flag_name, fns):
+            victims: Optional[List[TaskInfo]] = None
+            for fn in tier_fns:
+                candidates = fn(claimer, claimees)
+                if victims is None:
+                    victims = candidates
+                else:
+                    cand_uids = {c.uid for c in (candidates or [])}
+                    victims = [v for v in victims if v.uid in cand_uids]
+            if victims is not None:
+                return victims
+        return []
+
+    def reclaimable(self, reclaimer: TaskInfo, reclaimees: List[TaskInfo]) -> List[TaskInfo]:
+        return self._victims("enabled_reclaimable", self.reclaimable_fns, reclaimer, reclaimees)
+
+    def preemptable(self, preemptor: TaskInfo, preemptees: List[TaskInfo]) -> List[TaskInfo]:
+        return self._victims("enabled_preemptable", self.preemptable_fns, preemptor, preemptees)
+
+    def overused(self, queue: QueueInfo) -> bool:
+        """OR over all plugins, no enable flag (session_plugins.go:191-205)."""
+        for tier_fns in self._tier_plugins(None, self.overused_fns):
+            for fn in tier_fns:
+                if fn(queue):
+                    return True
+        return False
+
+    def job_ready(self, job: JobInfo) -> bool:
+        for tier_fns in self._tier_plugins("enabled_job_ready", self.job_ready_fns):
+            for fn in tier_fns:
+                if not fn(job):
+                    return False
+        return True
+
+    def job_pipelined(self, job: JobInfo) -> bool:
+        for tier_fns in self._tier_plugins("enabled_job_pipelined", self.job_pipelined_fns):
+            for fn in tier_fns:
+                if not fn(job):
+                    return False
+        return True
+
+    def job_valid(self, job: JobInfo):
+        for tier_fns in self._tier_plugins(None, self.job_valid_fns):
+            for fn in tier_fns:
+                vr = fn(job)
+                if vr is not None and not vr.pass_:
+                    return vr
+        return None
+
+    def job_enqueueable(self, job: JobInfo) -> bool:
+        for tier_fns in self._tier_plugins(None, self.job_enqueueable_fns):
+            for fn in tier_fns:
+                if not fn(job):
+                    return False
+        return True
+
+    def _order(self, flag_name: str, fns, l, r) -> int:
+        for tier_fns in self._tier_plugins(flag_name, fns):
+            for fn in tier_fns:
+                j = fn(l, r)
+                if j != 0:
+                    return j
+        return 0
+
+    def job_order_fn(self, l: JobInfo, r: JobInfo) -> bool:
+        j = self._order("enabled_job_order", self.job_order_fns, l, r)
+        if j != 0:
+            return j < 0
+        if l.creation_timestamp == r.creation_timestamp:
+            return l.uid < r.uid
+        return l.creation_timestamp < r.creation_timestamp
+
+    def namespace_order_fn(self, l: str, r: str) -> bool:
+        j = self._order("enabled_namespace_order", self.namespace_order_fns, l, r)
+        if j != 0:
+            return j < 0
+        return l < r
+
+    def queue_order_fn(self, l: QueueInfo, r: QueueInfo) -> bool:
+        j = self._order("enabled_queue_order", self.queue_order_fns, l, r)
+        if j != 0:
+            return j < 0
+        lt = l.queue.metadata.creation_timestamp
+        rt = r.queue.metadata.creation_timestamp
+        if lt == rt:
+            return l.uid < r.uid
+        return lt < rt
+
+    def task_compare_fns(self, l: TaskInfo, r: TaskInfo) -> int:
+        return self._order("enabled_task_order", self.task_order_fns, l, r)
+
+    def task_order_fn(self, l: TaskInfo, r: TaskInfo) -> bool:
+        res = self.task_compare_fns(l, r)
+        if res != 0:
+            return res < 0
+        lt = l.pod.metadata.creation_timestamp if l.pod else 0
+        rt = r.pod.metadata.creation_timestamp if r.pod else 0
+        if lt == rt:
+            return l.uid < r.uid
+        return lt < rt
+
+    def predicate_fn(self, task: TaskInfo, node: NodeInfo) -> None:
+        """Chains all enabled predicates; raises FitFailure on first miss."""
+        for tier_fns in self._tier_plugins("enabled_predicate", self.predicate_fns):
+            for fn in tier_fns:
+                fn(task, node)
+
+    def node_order_fn(self, task: TaskInfo, node: NodeInfo) -> float:
+        score = 0.0
+        for tier_fns in self._tier_plugins("enabled_node_order", self.node_order_fns):
+            for fn in tier_fns:
+                score += fn(task, node)
+        return score
+
+    def batch_node_order_fn(self, task: TaskInfo, nodes: List[NodeInfo]) -> Dict[str, float]:
+        scores: Dict[str, float] = {}
+        for tier_fns in self._tier_plugins("enabled_node_order", self.batch_node_order_fns):
+            for fn in tier_fns:
+                for node_name, s in fn(task, nodes).items():
+                    scores[node_name] = scores.get(node_name, 0.0) + s
+        return scores
+
+    def node_order_map_fn(self, task: TaskInfo, node: NodeInfo):
+        """Returns ({plugin: score}, summed order score) (session_plugins.go:474)."""
+        node_score_map: Dict[str, float] = {}
+        priority_score = 0.0
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not conf.enabled(plugin.enabled_node_order):
+                    continue
+                fn = self.node_order_fns.get(plugin.name)
+                if fn is not None:
+                    priority_score += fn(task, node)
+                mfn = self.node_map_fns.get(plugin.name)
+                if mfn is not None:
+                    node_score_map[plugin.name] = mfn(task, node)
+        return node_score_map, priority_score
+
+    def node_order_reduce_fn(self, task: TaskInfo, plugin_node_scores: Dict[str, Dict[str, float]]) -> Dict[str, float]:
+        node_scores: Dict[str, float] = {}
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not conf.enabled(plugin.enabled_node_order):
+                    continue
+                rfn = self.node_reduce_fns.get(plugin.name)
+                if rfn is None:
+                    continue
+                scores = plugin_node_scores.get(plugin.name, {})
+                rfn(task, scores)
+                for host, s in scores.items():
+                    node_scores[host] = node_scores.get(host, 0.0) + s
+        return node_scores
+
+    # ------------------------------------------------------------------
+    # mutation API (session.go:198-369)
+    # ------------------------------------------------------------------
+
+    def statement(self):
+        from volcano_tpu.scheduler.framework.statement import Statement
+
+        return Statement(self)
+
+    def _fire_allocate(self, task: TaskInfo) -> None:
+        for eh in self.event_handlers:
+            if eh.allocate_func is not None:
+                eh.allocate_func(Event(task))
+
+    def _fire_deallocate(self, task: TaskInfo) -> None:
+        for eh in self.event_handlers:
+            if eh.deallocate_func is not None:
+                eh.deallocate_func(Event(task))
+
+    def pipeline(self, task: TaskInfo, hostname: str) -> None:
+        """Place onto releasing resources; session-state only (session.go:205-245)."""
+        job = self.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job} when pipelining")
+        job.update_task_status(task, TaskStatus.PIPELINED)
+        task.node_name = hostname
+        node = self.nodes.get(hostname)
+        if node is None:
+            raise KeyError(f"failed to find node {hostname}")
+        node.add_task(task)
+        self._fire_allocate(task)
+
+    def allocate(self, task: TaskInfo, hostname: str) -> None:
+        """Allocate onto idle resources; dispatches the whole job when it
+        becomes gang-ready (session.go:248-303)."""
+        self.cache.allocate_volumes(task, hostname)
+        job = self.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job}")
+        job.update_task_status(task, TaskStatus.ALLOCATED)
+        task.node_name = hostname
+        node = self.nodes.get(hostname)
+        if node is None:
+            raise KeyError(f"failed to find node {hostname}")
+        node.add_task(task)
+        self._fire_allocate(task)
+
+        if self.job_ready(job):
+            for t in list(job.task_status_index.get(TaskStatus.ALLOCATED, {}).values()):
+                self.dispatch(t)
+
+    def dispatch(self, task: TaskInfo) -> None:
+        """(session.go:305-329)"""
+        self.cache.bind_volumes(task)
+        self.cache.bind(task, task.node_name)
+        job = self.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job}")
+        job.update_task_status(task, TaskStatus.BINDING)
+
+    def evict(self, reclaimee: TaskInfo, reason: str) -> None:
+        """(session.go:332-369)"""
+        self.cache.evict(reclaimee, reason)
+        job = self.jobs.get(reclaimee.job)
+        if job is None:
+            raise KeyError(f"failed to find job {reclaimee.job}")
+        job.update_task_status(reclaimee, TaskStatus.RELEASING)
+        node = self.nodes.get(reclaimee.node_name)
+        if node is not None:
+            node.update_task(reclaimee)
+        self._fire_deallocate(reclaimee)
+
+    def update_job_condition(self, job_info: JobInfo, cond: objects.PodGroupCondition) -> None:
+        """(session.go:372-394)"""
+        job = self.jobs.get(job_info.uid)
+        if job is None:
+            raise KeyError(f"failed to find job {job_info.namespace}/{job_info.name}")
+        for i, c in enumerate(job.pod_group.status.conditions):
+            if c.type == cond.type:
+                job.pod_group.status.conditions[i] = cond
+                return
+        job.pod_group.status.conditions.append(cond)
+
+
+def job_status(ssn: Session, job_info: JobInfo) -> objects.PodGroupStatus:
+    """Compute the PodGroup status to write back at session close
+    (session.go:157-195)."""
+    status = job_info.pod_group.status.clone()
+
+    unschedulable = any(
+        c.type == objects.POD_GROUP_UNSCHEDULABLE_TYPE
+        and c.status == "True"
+        and c.transition_id == ssn.uid
+        for c in status.conditions
+    )
+
+    if job_info.task_status_index.get(TaskStatus.RUNNING) and unschedulable:
+        status.phase = objects.PodGroupPhase.UNKNOWN
+    else:
+        allocated = 0
+        for st, tasks in job_info.task_status_index.items():
+            if allocated_status(st) or st == TaskStatus.SUCCEEDED:
+                allocated += len(tasks)
+        if allocated >= job_info.pod_group.spec.min_member:
+            status.phase = objects.PodGroupPhase.RUNNING
+        elif job_info.pod_group.status.phase != objects.PodGroupPhase.INQUEUE:
+            status.phase = objects.PodGroupPhase.PENDING
+
+    status.running = len(job_info.task_status_index.get(TaskStatus.RUNNING, {}))
+    status.failed = len(job_info.task_status_index.get(TaskStatus.FAILED, {}))
+    status.succeeded = len(job_info.task_status_index.get(TaskStatus.SUCCEEDED, {}))
+    return status
+
+
+def open_session_state(ssn: Session) -> None:
+    """Fill the session from the cache snapshot and drop invalid jobs
+    (session.go:72-139)."""
+    snapshot: ClusterInfo = ssn.cache.snapshot()
+    ssn.jobs = snapshot.jobs
+    for job in list(ssn.jobs.values()):
+        if job.pod_group is not None and job.pod_group.status.conditions:
+            ssn.pod_group_status[job.uid] = job.pod_group.status.clone()
+        vjr = ssn.job_valid(job)
+        if vjr is not None:
+            if not vjr.pass_:
+                jc = objects.PodGroupCondition(
+                    type=objects.POD_GROUP_UNSCHEDULABLE_TYPE,
+                    status="True",
+                    transition_id=ssn.uid,
+                    reason=vjr.reason,
+                    message=vjr.message,
+                )
+                try:
+                    ssn.update_job_condition(job, jc)
+                except (KeyError, AttributeError):
+                    pass
+            del ssn.jobs[job.uid]
+    ssn.nodes = snapshot.nodes
+    ssn.queues = snapshot.queues
+    ssn.namespace_info = snapshot.namespace_info

@@ -1,0 +1,187 @@
+"""Scheduler metrics under namespace ``volcano``
+(volcano pkg/scheduler/metrics/metrics.go:37-121).
+
+Self-contained histogram/counter/gauge registry rendering the Prometheus text
+exposition format, with the reference's exact series names:
+
+- volcano_e2e_scheduling_latency_milliseconds (histogram, 5ms*2^k buckets)
+- volcano_plugin_scheduling_latency_microseconds{plugin,OnSession}
+- volcano_action_scheduling_latency_microseconds{action}
+- volcano_task_scheduling_latency_microseconds
+- volcano_schedule_attempts_total{result}
+- volcano_pod_preemption_victims / volcano_total_preemption_attempts
+- volcano_unschedule_task_count{job_id} / volcano_unschedule_job_count
+- volcano_job_retry_counts{job_id}
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_NAMESPACE = "volcano"
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets: List[float], label_names=()):
+        self.name = name
+        self.help = help_
+        self.buckets = sorted(buckets)
+        self.label_names = tuple(label_names)
+        self._data: Dict[Tuple[str, ...], Tuple[List[int], float, int]] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, labels: Tuple[str, ...] = ()) -> None:
+        with self._lock:
+            counts, total, n = self._data.get(labels, ([0] * len(self.buckets), 0.0, 0))
+            counts = list(counts)
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._data[labels] = (counts, total + value, n + 1)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._data)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, label_names=()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._data: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, labels: Tuple[str, ...] = (), value: float = 1.0) -> None:
+        with self._lock:
+            self._data[labels] = self._data.get(labels, 0.0) + value
+
+    def get(self, labels: Tuple[str, ...] = ()) -> float:
+        with self._lock:
+            return self._data.get(labels, 0.0)
+
+
+class Registry:
+    def __init__(self):
+        ms = [0.005 * (2**k) for k in range(10)]  # 5ms..~5s, in seconds
+        us = [5e-6 * (2**k) for k in range(12)]
+        self.e2e_latency = Histogram(
+            f"{_NAMESPACE}_e2e_scheduling_latency_milliseconds",
+            "E2e scheduling latency in milliseconds", ms)
+        self.plugin_latency = Histogram(
+            f"{_NAMESPACE}_plugin_scheduling_latency_microseconds",
+            "Plugin scheduling latency in microseconds", us, ("plugin", "OnSession"))
+        self.action_latency = Histogram(
+            f"{_NAMESPACE}_action_scheduling_latency_microseconds",
+            "Action scheduling latency in microseconds", us, ("action",))
+        self.task_latency = Histogram(
+            f"{_NAMESPACE}_task_scheduling_latency_microseconds",
+            "Task scheduling latency in microseconds", us)
+        self.schedule_attempts = Counter(
+            f"{_NAMESPACE}_schedule_attempts_total",
+            "Num of attempts to schedule pods, by result", ("result",))
+        self.preemption_victims = Counter(
+            f"{_NAMESPACE}_pod_preemption_victims", "Number of preemption victims")
+        self.preemption_attempts = Counter(
+            f"{_NAMESPACE}_total_preemption_attempts", "Total preemption attempts")
+        self.unschedule_task_count = Counter(
+            f"{_NAMESPACE}_unschedule_task_count", "Unschedulable tasks per job", ("job_id",))
+        self.unschedule_job_count = Counter(
+            f"{_NAMESPACE}_unschedule_job_count", "Number of unschedulable jobs")
+        self.job_retry_counts = Counter(
+            f"{_NAMESPACE}_job_retry_counts", "Job retries", ("job_id",))
+
+
+_registry: Optional[Registry] = None
+_registry_lock = threading.Lock()
+
+
+def registry() -> Registry:
+    global _registry
+    with _registry_lock:
+        if _registry is None:
+            _registry = Registry()
+        return _registry
+
+
+def reset() -> None:
+    global _registry
+    with _registry_lock:
+        _registry = None
+
+
+# -- recording helpers (metrics.go:123-191) ---------------------------------
+
+
+def update_e2e_duration(seconds: float) -> None:
+    registry().e2e_latency.observe(seconds)
+
+
+def update_plugin_duration(plugin: str, on_session: str, seconds: float) -> None:
+    registry().plugin_latency.observe(seconds, (plugin, on_session))
+
+
+def update_action_duration(action: str, seconds: float) -> None:
+    registry().action_latency.observe(seconds, (action,))
+
+
+def update_task_schedule_duration(seconds: float) -> None:
+    registry().task_latency.observe(seconds)
+
+
+def register_schedule_attempts(result: str) -> None:
+    registry().schedule_attempts.inc((result,))
+
+
+def update_preemption_victims(n: int) -> None:
+    registry().preemption_victims.inc(value=n)
+
+
+def register_preemption_attempts() -> None:
+    registry().preemption_attempts.inc()
+
+
+def update_unschedule_task_count(job_id: str, n: int) -> None:
+    registry().unschedule_task_count.inc((job_id,), n)
+
+
+def update_unschedule_job_count(n: int = 1) -> None:
+    registry().unschedule_job_count.inc(value=n)
+
+
+def register_job_retry(job_id: str) -> None:
+    registry().job_retry_counts.inc((job_id,))
+
+
+# -- exposition -------------------------------------------------------------
+
+
+def render() -> str:
+    """Prometheus text format for the /metrics endpoint analog."""
+    r = registry()
+    lines: List[str] = []
+    for h in (r.e2e_latency, r.plugin_latency, r.action_latency, r.task_latency):
+        lines.append(f"# HELP {h.name} {h.help}")
+        lines.append(f"# TYPE {h.name} histogram")
+        for labels, (counts, total, n) in h.snapshot().items():
+            label_str = ",".join(f'{k}="{v}"' for k, v in zip(h.label_names, labels))
+            for b, c in zip(h.buckets, counts):
+                le = f'le="{b}"'
+                full = ",".join(x for x in (label_str, le) if x)
+                lines.append(f"{h.name}_bucket{{{full}}} {c}")
+            suffix = f"{{{label_str}}}" if label_str else ""
+            lines.append(f"{h.name}_sum{suffix} {total}")
+            lines.append(f"{h.name}_count{suffix} {n}")
+    for c in (
+        r.schedule_attempts, r.preemption_victims, r.preemption_attempts,
+        r.unschedule_task_count, r.unschedule_job_count, r.job_retry_counts,
+    ):
+        lines.append(f"# HELP {c.name} {c.help}")
+        lines.append(f"# TYPE {c.name} counter")
+        with c._lock:
+            for labels, v in c._data.items():
+                label_str = ",".join(f'{k}="{v2}"' for k, v2 in zip(c.label_names, labels))
+                suffix = f"{{{label_str}}}" if label_str else ""
+                lines.append(f"{c.name}{suffix} {v}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,231 @@
+"""predicates — node feasibility checks
+(volcano pkg/scheduler/plugins/predicates/predicates.go).
+
+The reference chains upstream k8s predicate functions over a parallel
+``cache.NodeInfo`` map it maintains with event handlers; here the same checks
+are implemented natively over the session's NodeInfo (whose task set the
+session keeps current through allocate/evict), in the same order:
+
+pod count -> node condition -> unschedulable -> node selector (+ required
+node affinity) -> host ports -> taints/tolerations -> optional memory/disk/
+pid pressure -> pod (anti-)affinity with required-term symmetry.
+
+Each failure raises FitFailure with reason strings matching upstream phrasing
+so fit-error histograms are comparable.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.unschedule_info import FitFailure
+from volcano_tpu.scheduler.framework.interface import Plugin
+
+PLUGIN_NAME = "predicates"
+
+MEMORY_PRESSURE_PREDICATE = "predicate.MemoryPressureEnable"
+DISK_PRESSURE_PREDICATE = "predicate.DiskPressureEnable"
+PID_PRESSURE_PREDICATE = "predicate.PIDPressureEnable"
+
+NODE_POD_NUMBER_EXCEEDED = "node(s) pod number exceeded"
+
+HOSTNAME_TOPOLOGY_KEY = "kubernetes.io/hostname"
+
+
+def _node_topology_value(node: NodeInfo, key: str) -> str:
+    labels = node.node.metadata.labels if node.node is not None else {}
+    if key == HOSTNAME_TOPOLOGY_KEY and key not in labels:
+        return node.name
+    return labels.get(key, "")
+
+
+def _pods_on_node(node: NodeInfo) -> List[objects.Pod]:
+    return [t.pod for t in node.tasks.values() if t.pod is not None]
+
+
+def _selector_matches_pod(term: objects.PodAffinityTerm, pod: objects.Pod, incoming_ns: str) -> bool:
+    namespaces = term.namespaces or [incoming_ns]
+    if pod.metadata.namespace not in namespaces:
+        return False
+    if term.label_selector is None:
+        return False
+    return term.label_selector.matches(pod.metadata.labels)
+
+
+def pod_matches_node_selector(pod: objects.Pod, node: NodeInfo) -> bool:
+    """nodeSelector AND required node-affinity terms (PodMatchNodeSelector)."""
+    labels = node.node.metadata.labels if node.node is not None else {}
+    for k, v in pod.spec.node_selector.items():
+        if labels.get(k) != v:
+            return False
+    affinity = pod.spec.affinity
+    if affinity is not None and affinity.node_affinity is not None:
+        required = affinity.node_affinity.required_terms
+        if required and not any(term.matches(labels) for term in required):
+            return False
+    return True
+
+
+def tolerates_taints(pod: objects.Pod, node: NodeInfo) -> bool:
+    """NoSchedule/NoExecute taints must be tolerated (PodToleratesNodeTaints)."""
+    if node.node is None:
+        return True
+    for taint in node.node.spec.taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue  # PreferNoSchedule never blocks
+        if not any(t.tolerates(taint) for t in pod.spec.tolerations):
+            return False
+    return True
+
+
+def host_ports_free(pod: objects.Pod, node: NodeInfo) -> bool:
+    wanted = {
+        (p.host_port, p.protocol)
+        for c in pod.spec.containers
+        for p in c.ports
+        if p.host_port > 0
+    }
+    if not wanted:
+        return True
+    for existing in _pods_on_node(node):
+        for c in existing.spec.containers:
+            for p in c.ports:
+                if p.host_port > 0 and (p.host_port, p.protocol) in wanted:
+                    return False
+    return True
+
+
+def _affinity_term_satisfied(term: objects.PodAffinityTerm, pod: objects.Pod,
+                             node: NodeInfo, all_nodes: List[NodeInfo]) -> bool:
+    """Some existing pod matching the selector runs in the node's topology
+    domain for term.topology_key."""
+    my_topo = _node_topology_value(node, term.topology_key)
+    for other in all_nodes:
+        if _node_topology_value(other, term.topology_key) != my_topo:
+            continue
+        for existing in _pods_on_node(other):
+            if _selector_matches_pod(term, existing, pod.metadata.namespace):
+                return True
+    return False
+
+
+def _anti_affinity_violated(term: objects.PodAffinityTerm, pod: objects.Pod,
+                            node: NodeInfo, all_nodes: List[NodeInfo]) -> bool:
+    return _affinity_term_satisfied(term, pod, node, all_nodes)
+
+
+def _term_matches_no_pod_but_self(term: objects.PodAffinityTerm, pod: objects.Pod,
+                                  all_nodes: List[NodeInfo]) -> bool:
+    """Upstream carve-out (vendored predicates.go:1380-1389): a required
+    affinity term that matches NO existing pod anywhere is allowed when the
+    incoming pod matches its own selector — so the first pod of a
+    self-affine gang can land."""
+    for other in all_nodes:
+        for existing in _pods_on_node(other):
+            if _selector_matches_pod(term, existing, pod.metadata.namespace):
+                return False
+    return _selector_matches_pod(term, pod, pod.metadata.namespace)
+
+
+def pod_affinity_fits(pod: objects.Pod, node: NodeInfo, all_nodes: List[NodeInfo]) -> bool:
+    affinity = pod.spec.affinity
+    if affinity is not None:
+        if affinity.pod_affinity is not None:
+            for term in affinity.pod_affinity.required_terms:
+                if not _affinity_term_satisfied(term, pod, node, all_nodes) and \
+                        not _term_matches_no_pod_but_self(term, pod, all_nodes):
+                    return False
+        if affinity.pod_anti_affinity is not None:
+            for term in affinity.pod_anti_affinity.required_terms:
+                if _anti_affinity_violated(term, pod, node, all_nodes):
+                    return False
+    # symmetry: existing pods' required anti-affinity must not match us
+    for other in all_nodes:
+        for existing in _pods_on_node(other):
+            ea = existing.spec.affinity
+            if ea is None or ea.pod_anti_affinity is None:
+                continue
+            for term in ea.pod_anti_affinity.required_terms:
+                if not _selector_matches_pod(term, pod, existing.metadata.namespace):
+                    continue
+                topo = term.topology_key
+                if _node_topology_value(node, topo) == _node_topology_value(other, topo):
+                    return False
+    return True
+
+
+def _node_condition(node: NodeInfo, cond_type: str) -> bool:
+    if node.node is None:
+        return False
+    for cond in node.node.status.conditions:
+        if cond.type == cond_type:
+            return cond.status == "True"
+    return False
+
+
+class PredicatesPlugin(Plugin):
+    def __init__(self, arguments=None):
+        self.arguments = arguments or {}
+
+    def name(self) -> str:
+        return PLUGIN_NAME
+
+    def on_session_open(self, ssn) -> None:
+        from volcano_tpu.scheduler.framework.arguments import Arguments
+
+        args = self.arguments if isinstance(self.arguments, Arguments) else Arguments(self.arguments)
+        memory_pressure = args.get_bool(MEMORY_PRESSURE_PREDICATE, False)
+        disk_pressure = args.get_bool(DISK_PRESSURE_PREDICATE, False)
+        pid_pressure = args.get_bool(PID_PRESSURE_PREDICATE, False)
+
+        def predicate_fn(task: TaskInfo, node: NodeInfo) -> None:
+            pod = task.pod
+            if pod is None:
+                return
+            all_nodes = list(ssn.nodes.values())
+
+            # pod count (predicates.go:165)
+            if node.allocatable.max_task_num <= len(node.tasks):
+                raise FitFailure(NODE_POD_NUMBER_EXCEEDED)
+
+            # node conditions (CheckNodeConditionPredicate)
+            if not _node_condition(node, "Ready"):
+                raise FitFailure("node(s) were not ready")
+            if _node_condition(node, "NetworkUnavailable"):
+                raise FitFailure("node(s) had network unavailable")
+
+            # unschedulable spec (CheckNodeUnschedulablePredicate)
+            if node.node is not None and node.node.spec.unschedulable:
+                raise FitFailure("node(s) were unschedulable")
+
+            # node selector + required node affinity
+            if not pod_matches_node_selector(pod, node):
+                raise FitFailure("node(s) didn't match node selector")
+
+            # host ports
+            if not host_ports_free(pod, node):
+                raise FitFailure("node(s) didn't have free ports for the requested pod ports")
+
+            # taints
+            if not tolerates_taints(pod, node):
+                raise FitFailure("node(s) had taints that the pod didn't tolerate")
+
+            if memory_pressure and _node_condition(node, "MemoryPressure"):
+                raise FitFailure("node(s) had memory pressure")
+            if disk_pressure and _node_condition(node, "DiskPressure"):
+                raise FitFailure("node(s) had disk pressure")
+            if pid_pressure and _node_condition(node, "PIDPressure"):
+                raise FitFailure("node(s) had pid pressure")
+
+            # pod (anti-)affinity incl. required-term symmetry
+            if not pod_affinity_fits(pod, node, all_nodes):
+                raise FitFailure("node(s) didn't match pod affinity/anti-affinity")
+
+        ssn.add_predicate_fn(PLUGIN_NAME, predicate_fn)
+
+
+def new(arguments):
+    return PredicatesPlugin(arguments)

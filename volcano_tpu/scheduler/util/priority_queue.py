@@ -1,0 +1,50 @@
+"""Heap-based priority queue over an arbitrary less-fn
+(volcano pkg/scheduler/util/priority_queue.go)."""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Callable, Optional
+
+
+class _Item:
+    __slots__ = ("value", "less_fn", "seq")
+
+    def __init__(self, value, less_fn, seq):
+        self.value = value
+        self.less_fn = less_fn
+        self.seq = seq
+
+    def __lt__(self, other: "_Item") -> bool:
+        if self.less_fn is None:
+            return self.seq < other.seq
+        if self.less_fn(self.value, other.value):
+            return True
+        if self.less_fn(other.value, self.value):
+            return False
+        return self.seq < other.seq  # stable among equals
+
+
+class PriorityQueue:
+    """Pop returns the item for which less_fn says it orders before all
+    others ("highest priority first" by convention of the less fns)."""
+
+    def __init__(self, less_fn: Optional[Callable] = None):
+        self._heap: list[_Item] = []
+        self._less_fn = less_fn
+        self._seq = itertools.count()
+
+    def push(self, value) -> None:
+        heapq.heappush(self._heap, _Item(value, self._less_fn, next(self._seq)))
+
+    def pop(self):
+        if not self._heap:
+            return None
+        return heapq.heappop(self._heap).value
+
+    def empty(self) -> bool:
+        return not self._heap
+
+    def __len__(self) -> int:
+        return len(self._heap)

@@ -151,7 +151,7 @@ class FakeStatusUpdater:
     def update_pod_condition(self, pod, condition) -> None:
         pass
 
-    def update_pod_group(self, pg, status) -> None:
+    def update_pod_group(self, pg, status=None) -> None:
         pass
 
 

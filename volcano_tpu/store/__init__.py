@@ -1,0 +1,11 @@
+"""In-process event-sourced state substrate — the analog of the Kubernetes
+API server + CRDs (volcano's L0/L1): typed object buckets, resource
+versioning, watch streams, admission middleware, and an event recorder."""
+
+from volcano_tpu.store.store import (
+    AdmissionError,
+    ConflictError,
+    NotFoundError,
+    Store,
+    WatchHandler,
+)

@@ -1,0 +1,239 @@
+"""The state store: typed buckets + watch streams + admission middleware.
+
+Replaces the reference's distributed state store and message bus (the k8s API
+server, SURVEY L0). Volcano coordinates everything through watch/list/update
+on CRDs (installer/volcano-development.yaml; pkg/client generated informers);
+here the same contract is an in-process store:
+
+- ``create``/``update``/``update_status``/``delete`` mutate canonical objects
+  and bump a global resource version;
+- ``watch(kind, handler)`` delivers ADDED/MODIFIED/DELETED callbacks
+  synchronously under the store lock (informer-style: handlers must be fast
+  and must not call back into the store — they mirror state into their own
+  caches, exactly like volcano's scheduler cache event handlers);
+- admission middleware (mutators, then validators) runs on create, the seam
+  where volcano's webhooks sit (pkg/admission);
+- an event recorder stands in for k8s Events.
+
+Objects handed out by ``get``/``list`` are the canonical instances — callers
+must treat them as read-only and go through ``update`` (shared-informer
+convention). The scheduler cache clones what it needs into its snapshot.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from volcano_tpu.api import objects
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class ConflictError(RuntimeError):
+    pass
+
+
+class AdmissionError(ValueError):
+    """An admission validator rejected the request."""
+
+
+# Kinds without a namespace (keyed by bare name).
+CLUSTER_SCOPED = {"Node", "Queue", "PriorityClass"}
+
+
+def object_key(obj) -> str:
+    meta = obj.metadata
+    if type(obj).KIND in CLUSTER_SCOPED:
+        return meta.name
+    return f"{meta.namespace}/{meta.name}"
+
+
+@dataclass
+class WatchHandler:
+    """Informer-style callbacks. ``updated`` receives (old, new)."""
+
+    added: Optional[Callable] = None
+    updated: Optional[Callable] = None
+    deleted: Optional[Callable] = None
+
+
+@dataclass
+class RecordedEvent:
+    """Analog of a k8s Event object."""
+
+    object_kind: str
+    object_key: str
+    event_type: str  # Normal | Warning
+    reason: str
+    message: str
+    timestamp: float = field(default_factory=time.time)
+
+
+class Store:
+    """Thread-safe typed object store with watches and admission."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._buckets: Dict[str, Dict[str, object]] = {}
+        self._watchers: Dict[str, List[WatchHandler]] = {}
+        self._mutators: Dict[str, List[Callable]] = {}
+        self._validators: Dict[str, List[Callable]] = {}
+        self._resource_version = 0
+        self.events: List[RecordedEvent] = []
+
+    # -- admission ---------------------------------------------------------
+
+    def register_admission(
+        self,
+        kind: str,
+        mutator: Optional[Callable] = None,
+        validator: Optional[Callable] = None,
+    ) -> None:
+        """Install admission middleware for a kind. Mutators run first and
+        may modify the object in place; validators raise AdmissionError to
+        reject (the webhook seam, pkg/admission/admission_controller.go:40-44)."""
+        with self._lock:
+            if mutator is not None:
+                self._mutators.setdefault(kind, []).append(mutator)
+            if validator is not None:
+                self._validators.setdefault(kind, []).append(validator)
+
+    # -- writes ------------------------------------------------------------
+
+    def create(self, obj) -> object:
+        kind = type(obj).KIND
+        with self._lock:
+            for mutate in self._mutators.get(kind, []):
+                mutate(obj)
+            for validate in self._validators.get(kind, []):
+                validate(obj)
+
+            obj.metadata.ensure_identity()
+            key = object_key(obj)
+            bucket = self._buckets.setdefault(kind, {})
+            if key in bucket:
+                raise ConflictError(f"{kind} {key} already exists")
+            self._resource_version += 1
+            obj.metadata.resource_version = self._resource_version
+            bucket[key] = obj
+            self._dispatch(kind, "ADDED", None, obj)
+            return obj
+
+    def update(self, obj) -> object:
+        kind = type(obj).KIND
+        with self._lock:
+            key = object_key(obj)
+            bucket = self._buckets.setdefault(kind, {})
+            old = bucket.get(key)
+            if old is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            self._resource_version += 1
+            obj.metadata.resource_version = self._resource_version
+            bucket[key] = obj
+            self._dispatch(kind, "MODIFIED", old, obj)
+            return obj
+
+    def update_status(self, obj) -> object:
+        """Alias of update — status subresource writes share the path."""
+        return self.update(obj)
+
+    def delete(self, kind: str, namespace: str, name: str) -> object:
+        with self._lock:
+            key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
+            bucket = self._buckets.get(kind, {})
+            obj = bucket.pop(key, None)
+            if obj is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            self._resource_version += 1
+            self._dispatch(kind, "DELETED", obj, None)
+            return obj
+
+    def try_delete(self, kind: str, namespace: str, name: str) -> Optional[object]:
+        try:
+            return self.delete(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, kind: str, namespace: str, name: str) -> object:
+        with self._lock:
+            key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
+            obj = self._buckets.get(kind, {}).get(key)
+            if obj is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            return obj
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[object]:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        selector: Optional[Dict[str, str]] = None,
+    ) -> List[object]:
+        with self._lock:
+            items = list(self._buckets.get(kind, {}).values())
+        if namespace is not None and kind not in CLUSTER_SCOPED:
+            items = [o for o in items if o.metadata.namespace == namespace]
+        if selector:
+            items = [
+                o
+                for o in items
+                if all(o.metadata.labels.get(k) == v for k, v in selector.items())
+            ]
+        return items
+
+    @property
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._resource_version
+
+    # -- watches -----------------------------------------------------------
+
+    def watch(self, kind: str, handler: WatchHandler, replay: bool = True) -> None:
+        """Register an informer-style handler. With ``replay``, existing
+        objects are delivered as ADDED first (initial list+watch sync)."""
+        with self._lock:
+            self._watchers.setdefault(kind, []).append(handler)
+            if replay and handler.added is not None:
+                for obj in self._buckets.get(kind, {}).values():
+                    handler.added(obj)
+
+    def _dispatch(self, kind: str, event_type: str, old, new) -> None:
+        for handler in self._watchers.get(kind, []):
+            if event_type == "ADDED" and handler.added is not None:
+                handler.added(new)
+            elif event_type == "MODIFIED" and handler.updated is not None:
+                handler.updated(old, new)
+            elif event_type == "DELETED" and handler.deleted is not None:
+                handler.deleted(old)
+
+    # -- events (k8s Events analog) ---------------------------------------
+
+    def record_event(self, obj, event_type: str, reason: str, message: str) -> None:
+        with self._lock:
+            self.events.append(
+                RecordedEvent(
+                    object_kind=type(obj).KIND,
+                    object_key=object_key(obj),
+                    event_type=event_type,
+                    reason=reason,
+                    message=message,
+                )
+            )
+
+    def events_for(self, obj) -> List[RecordedEvent]:
+        key = object_key(obj)
+        kind = type(obj).KIND
+        with self._lock:
+            return [e for e in self.events if e.object_kind == kind and e.object_key == key]

@@ -1,16 +1,24 @@
 """Test configuration.
 
 Forces JAX onto an 8-device virtual CPU mesh so multi-chip sharding paths can
-be exercised without TPU hardware, and enables panic-on-assert so resource
-accounting violations fail tests loudly.
+be exercised without TPU hardware (the sandbox's sitecustomize registers the
+real TPU backend and pins JAX_PLATFORMS, so the override must go through
+jax.config after import), enables float64 so device parity tests match the
+host oracle's arithmetic bit-for-bit (TPU bench runs use float32; see
+ops/solver.py), and enables panic-on-assert so resource accounting violations
+fail tests loudly.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["VOLCANO_TPU_PANIC"] = "1"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,313 @@
+"""Binding parity: TPU batch solve vs the serial oracle loop.
+
+The BASELINE.json gate: the batched device solve must produce *identical
+binding decisions* to the serial allocate action. Each case builds two
+identical synthetic clusters, runs the serial loop on one and the
+tpuscore-gated batch solve on the other, and compares the FakeBinder maps
+byte-for-byte. Runs on the 8-device virtual CPU mesh in float64 (conftest),
+so host and device arithmetic agree exactly.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from tests.helpers import make_cache, make_tiers
+from volcano_tpu.api import objects
+from volcano_tpu.scheduler.framework import close_session, get_action, open_session
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list_with_pods,
+)
+
+DEFAULT_TIERS = (["priority", "gang"], ["drf", "predicates", "proportion", "nodeorder"])
+
+
+def run_backend(populate, tiers, tpu: bool):
+    cache = make_cache()
+    populate(cache)
+    tier_spec = list(tiers)
+    if tpu:
+        tier_spec = [["tpuscore"], *tier_spec]
+    ssn = open_session(cache, make_tiers(*tier_spec))
+    get_action("allocate").execute(ssn)
+    if tpu:
+        assert getattr(ssn, "batch_allocator", None) is not None
+        prof = ssn.plugins["tpuscore"].profile
+        assert "fallback" not in prof, f"unexpected serial fallback: {prof}"
+    close_session(ssn)
+    return cache.binder.binds
+
+
+def assert_parity(populate, tiers=DEFAULT_TIERS):
+    serial = run_backend(populate, tiers, tpu=False)
+    batched = run_backend(populate, tiers, tpu=True)
+    assert batched == serial, (
+        f"binding divergence: serial={len(serial)} batched={len(batched)} "
+        f"only_serial={dict(sorted(set(serial.items()) - set(batched.items()))[:5])} "
+        f"only_batched={dict(sorted(set(batched.items()) - set(serial.items()))[:5])}"
+    )
+    return serial
+
+
+def gang_cluster(n_groups=12, min_member=4, n_nodes=8, seed=0):
+    def populate(c):
+        rng = random.Random(seed)  # fresh stream per cluster build
+        c.add_queue(build_queue("default"))
+        for g in range(n_groups):
+            pg = f"pg{g}"
+            c.add_pod_group(build_pod_group(pg, namespace="ns1", min_member=min_member))
+            for i in range(min_member):
+                c.add_pod(build_pod(
+                    "ns1", f"{pg}-p{i}", "", objects.POD_PHASE_PENDING,
+                    {"cpu": f"{rng.choice([500, 1000, 2000])}m", "memory": "1Gi"},
+                    pg))
+        for n in range(n_nodes):
+            c.add_node(build_node(
+                f"node-{n:03d}", build_resource_list_with_pods("8", "16Gi")))
+
+    return populate
+
+
+class TestTpuParity:
+    def test_gang_blocks_default_conf(self):
+        binds = assert_parity(gang_cluster())
+        assert len(binds) > 0
+
+    def test_gang_partial_capacity(self):
+        # capacity for only some gangs; later gangs must discard whole blocks
+        binds = assert_parity(gang_cluster(n_groups=20, min_member=4, n_nodes=4))
+        assert len(binds) % 4 == 0  # whole gangs only
+
+    def test_gang_no_capacity(self):
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            c.add_pod_group(build_pod_group("pg1", namespace="ns1", min_member=5))
+            for i in range(5):
+                c.add_pod(build_pod("ns1", f"p{i}", "", objects.POD_PHASE_PENDING,
+                                    {"cpu": "3", "memory": "1Gi"}, "pg1"))
+            c.add_node(build_node("n1", build_resource_list_with_pods("4", "8Gi")))
+        assert assert_parity(populate) == {}
+
+    def test_heterogeneous_binpack(self):
+        def populate(c):
+            rng = random.Random(7)
+            c.add_queue(build_queue("default"))
+            for g in range(15):
+                pg = f"pg{g}"
+                c.add_pod_group(build_pod_group(pg, namespace="ns1", min_member=1))
+                for i in range(rng.randint(1, 4)):
+                    req = {
+                        "cpu": f"{rng.choice([250, 500, 1500])}m",
+                        "memory": rng.choice(["512Mi", "1Gi", "2Gi"]),
+                    }
+                    if rng.random() < 0.3:
+                        req["nvidia.com/gpu"] = "1"
+                    c.add_pod(build_pod("ns1", f"{pg}-p{i}", "",
+                                        objects.POD_PHASE_PENDING, req, pg))
+            for n in range(10):
+                rl = build_resource_list_with_pods("4", "8Gi")
+                if n % 2 == 0:
+                    rl["nvidia.com/gpu"] = "4"
+                c.add_node(build_node(f"node-{n:03d}", rl))
+
+        assert_parity(
+            populate,
+            tiers=(["priority", "gang"], ["predicates", "binpack"]),
+        )
+
+    def test_node_selectors(self):
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            for g, zone in enumerate(["a", "b", "a", "b", "a"]):
+                pg = f"pg{g}"
+                c.add_pod_group(build_pod_group(pg, namespace="ns1", min_member=2))
+                for i in range(2):
+                    c.add_pod(build_pod("ns1", f"{pg}-p{i}", "",
+                                        objects.POD_PHASE_PENDING,
+                                        {"cpu": "1", "memory": "1Gi"}, pg,
+                                        node_selector={"zone": zone}))
+            for n in range(6):
+                c.add_node(build_node(
+                    f"node-{n:03d}", build_resource_list_with_pods("4", "8Gi"),
+                    labels={"zone": "a" if n < 3 else "b"}))
+
+        serial = assert_parity(populate)
+        assert len(serial) == 10
+
+    def test_multi_queue_fair_share(self):
+        def populate(c):
+            rng = random.Random(3)
+            c.add_queue(build_queue("q-gold", weight=3))
+            c.add_queue(build_queue("q-silver", weight=2))
+            c.add_queue(build_queue("q-bronze", weight=1))
+            for g in range(18):
+                q = ["q-gold", "q-silver", "q-bronze"][g % 3]
+                pg = f"pg{g}"
+                c.add_pod_group(build_pod_group(pg, namespace="ns1",
+                                                min_member=2, queue=q))
+                for i in range(3):
+                    c.add_pod(build_pod("ns1", f"{pg}-p{i}", "",
+                                        objects.POD_PHASE_PENDING,
+                                        {"cpu": f"{rng.choice([500, 1000])}m",
+                                         "memory": "1Gi"}, pg))
+            for n in range(6):
+                c.add_node(build_node(
+                    f"node-{n:03d}", build_resource_list_with_pods("6", "12Gi")))
+
+        assert_parity(populate)
+
+    def test_priorities_order(self):
+        # job priority flows from the PodGroup's PriorityClassName
+        # (reference cache.go:741-748), not from pod priority
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            for g in range(6):
+                pc = objects.PriorityClass(
+                    metadata=objects.ObjectMeta(name=f"prio-{g}"), value=g)
+                pc.metadata.ensure_identity()
+                c.add_priority_class(pc)
+            for g in range(6):
+                pg = f"pg{g}"
+                pgobj = build_pod_group(pg, namespace="ns1", min_member=2)
+                pgobj.spec.priority_class_name = f"prio-{g}"
+                c.add_pod_group(pgobj)
+                for i in range(2):
+                    c.add_pod(build_pod("ns1", f"{pg}-p{i}", "",
+                                        objects.POD_PHASE_PENDING,
+                                        {"cpu": "2", "memory": "2Gi"}, pg))
+            # capacity for 3 gangs only -> highest priorities win
+            c.add_node(build_node("n1", build_resource_list_with_pods("12", "24Gi")))
+
+        binds = assert_parity(populate)
+        bound_groups = {k.split("/")[1].rsplit("-", 1)[0] for k in binds}
+        assert bound_groups == {"pg5", "pg4", "pg3"}
+
+    def test_node_sampling_window(self):
+        # >100 nodes triggers the adaptive sampling + round-robin window
+        # (scheduler_helper.go:42-118); the kernel must reproduce it exactly
+        def populate(c):
+            rng = random.Random(11)
+            c.add_queue(build_queue("default"))
+            for g in range(25):
+                pg = f"pg{g}"
+                c.add_pod_group(build_pod_group(pg, namespace="ns1", min_member=2))
+                for i in range(2):
+                    c.add_pod(build_pod("ns1", f"{pg}-p{i}", "",
+                                        objects.POD_PHASE_PENDING,
+                                        {"cpu": f"{rng.choice([1000, 2000])}m",
+                                         "memory": "1Gi"}, pg))
+            for n in range(120):
+                c.add_node(build_node(
+                    f"node-{n:03d}", build_resource_list_with_pods("2", "4Gi")))
+
+        assert_parity(populate)
+
+    def test_multi_namespace(self):
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            for ns in ("ns-a", "ns-b"):
+                for g in range(4):
+                    pg = f"{ns}-pg{g}"
+                    c.add_pod_group(build_pod_group(pg, namespace=ns, min_member=2))
+                    for i in range(2):
+                        c.add_pod(build_pod(ns, f"{pg}-p{i}", "",
+                                            objects.POD_PHASE_PENDING,
+                                            {"cpu": "1", "memory": "1Gi"}, pg))
+            for n in range(4):
+                c.add_node(build_node(
+                    f"node-{n:03d}", build_resource_list_with_pods("4", "8Gi")))
+
+        assert_parity(populate)
+
+    def test_reordered_tiers_drf_before_priority(self):
+        # job-order dispatch is first-nonzero ACROSS TIERS, so putting drf in
+        # tier 1 must beat priority in tier 2 on both backends
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            pc = objects.PriorityClass(metadata=objects.ObjectMeta(name="hi"), value=100)
+            pc.metadata.ensure_identity()
+            c.add_priority_class(pc)
+            # job A: high priority, already-running share; job B: zero share
+            pg_a = build_pod_group("pg-a", namespace="ns1", min_member=1)
+            pg_a.spec.priority_class_name = "hi"
+            c.add_pod_group(pg_a)
+            c.add_pod(build_pod("ns1", "a-run", "n1", objects.POD_PHASE_RUNNING,
+                                {"cpu": "2", "memory": "2Gi"}, "pg-a"))
+            c.add_pod(build_pod("ns1", "a-p0", "", objects.POD_PHASE_PENDING,
+                                {"cpu": "1", "memory": "1Gi"}, "pg-a"))
+            c.add_pod_group(build_pod_group("pg-b", namespace="ns1", min_member=1))
+            c.add_pod(build_pod("ns1", "b-p0", "", objects.POD_PHASE_PENDING,
+                                {"cpu": "1", "memory": "1Gi"}, "pg-b"))
+            c.add_node(build_node("n1", build_resource_list_with_pods("4", "8Gi")))
+
+        binds = assert_parity(
+            populate, tiers=(["drf"], ["priority", "gang"], ["proportion"]))
+        assert "ns1/b-p0" in binds  # zero-share job goes first under DRF
+
+    def test_mesh_sharded_non_divisible_nodes(self):
+        # 5 nodes on an 8-device mesh: the node axis pads to 8 and the
+        # sampling window must still match the serial helper over 5 nodes
+        import jax
+        from jax.sharding import Mesh
+
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            for g in range(6):
+                pg = f"pg{g}"
+                c.add_pod_group(build_pod_group(pg, namespace="ns1", min_member=2))
+                for i in range(2):
+                    c.add_pod(build_pod("ns1", f"{pg}-p{i}", "",
+                                        objects.POD_PHASE_PENDING,
+                                        {"cpu": "1", "memory": "1Gi"}, pg))
+            for n in range(5):
+                c.add_node(build_node(
+                    f"node-{n:03d}", build_resource_list_with_pods("3", "6Gi")))
+
+        serial = run_backend(populate, DEFAULT_TIERS, tpu=False)
+
+        cache = make_cache()
+        populate(cache)
+        ssn = open_session(cache, make_tiers(["tpuscore"], *DEFAULT_TIERS))
+        mesh = Mesh(np.array(jax.devices()[:8]), ("nodes",))
+        ssn.plugins["tpuscore"].mesh = mesh
+        ssn.batch_allocator.mesh = mesh
+        get_action("allocate").execute(ssn)
+        prof = ssn.plugins["tpuscore"].profile
+        assert "fallback" not in prof, prof
+        close_session(ssn)
+        assert cache.binder.binds == serial
+
+    def test_fallback_on_pod_affinity(self):
+        """Sessions with constructs the kernel doesn't model must fall back
+        to the serial loop, not silently mis-schedule."""
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            c.add_pod_group(build_pod_group("pg1", namespace="ns1", min_member=1))
+            pod = build_pod("ns1", "p1", "", objects.POD_PHASE_PENDING,
+                            {"cpu": "1", "memory": "1Gi"}, "pg1",
+                            labels={"app": "x"})
+            pod.spec.affinity = objects.Affinity(
+                pod_anti_affinity=objects.PodAntiAffinity(required_terms=[
+                    objects.PodAffinityTerm(
+                        label_selector=objects.LabelSelector(match_labels={"app": "x"}),
+                        topology_key="kubernetes.io/hostname",
+                    )
+                ])
+            )
+            c.add_pod(pod)
+            c.add_node(build_node("n1", build_resource_list_with_pods("4", "8Gi")))
+
+        cache = make_cache()
+        populate(cache)
+        ssn = open_session(cache, make_tiers(["tpuscore"], *DEFAULT_TIERS))
+        get_action("allocate").execute(ssn)
+        prof = ssn.plugins["tpuscore"].profile
+        assert "fallback" in prof
+        close_session(ssn)
+        assert cache.binder.binds == {"ns1/p1": "n1"}

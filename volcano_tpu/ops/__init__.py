@@ -1,0 +1,31 @@
+"""TPU-native batched placement solver.
+
+This package replaces the serial (task x node) sweep of the allocate action
+(reference: volcano pkg/scheduler/actions/allocate/allocate.go:42-247 and
+pkg/scheduler/util/scheduler_helper.go:64-211) with a single compiled JAX
+program: per scheduling "visit" the kernel computes an N-wide feasibility
+mask, fused binpack+nodeorder scores, and a deterministic argmax on device,
+with gang commit/rollback semantics preserved exactly.
+
+Layout decisions (TPU-first):
+- no dense (T x N) tensors: tasks are grouped into predicate *signatures*
+  (pods stamped from the same template share node-selector/taint/affinity
+  constraints), so static feasibility is an (S x N) mask with S << T;
+- all per-visit work is O(N*R) vector ops + O(J) / O(Q) selection reductions,
+  which XLA fuses; the node axis shards across chips via jax.sharding.Mesh;
+- scores/feasibility default to float32 on TPU; parity tests run float64 on
+  the CPU mesh so device results can be compared bit-for-bit against the
+  Python oracle loop.
+"""
+
+from volcano_tpu.ops.encoder import EncodedSnapshot, EncoderFallback, encode_session
+from volcano_tpu.ops.kernels import solve_allocate
+from volcano_tpu.ops.solver import BatchAllocator
+
+__all__ = [
+    "EncodedSnapshot",
+    "EncoderFallback",
+    "encode_session",
+    "solve_allocate",
+    "BatchAllocator",
+]

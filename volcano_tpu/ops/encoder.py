@@ -1,0 +1,480 @@
+"""Session -> dense-tensor encoder for the TPU allocate solver.
+
+Packs the scheduler session (volcano pkg/scheduler/framework/session.go:37)
+into the arrays consumed by ops.kernels.solve_allocate. Key ideas:
+
+- **Predicate signatures**: pods stamped from one template share
+  node-selector / affinity / toleration constraints, so static feasibility is
+  an (S x N) mask with S << T instead of (T x N) — the inter-pod-affinity
+  precompute suggested by the reference's own hot-loop analysis
+  (predicates.go:281-299 is O(pods x nodes) in Go; here it's S host
+  evaluations).
+- **Exact order keys**: job/queue/namespace comparators
+  (session_plugins.go:287-440) become rank arrays; dynamic keys (DRF share,
+  gang readiness, proportion queue share) are recomputed on device each
+  visit.
+- **Fallback honesty**: any construct the kernel does not model (releasing
+  resources -> pipelining, pod (anti-)affinity, host ports, unknown plugins
+  on order/predicate/score extension points) raises EncoderFallback and the
+  action runs the serial oracle loop instead.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import cmp_to_key
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.job_info import JobInfo, TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.resource import (
+    MIN_MEMORY,
+    MIN_MILLI_CPU,
+    MIN_MILLI_SCALAR,
+    Resource,
+)
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.ops.kernels import SolveSpec
+from volcano_tpu.scheduler import conf
+from volcano_tpu.scheduler.plugins import nodeorder as nodeorder_mod
+from volcano_tpu.scheduler.plugins import predicates as predicates_mod
+
+SUPPORTED_JOB_ORDER = ("priority", "gang", "drf")
+SUPPORTED_QUEUE_ORDER = ("proportion",)
+SUPPORTED_NODE_ORDER = ("nodeorder", "binpack")
+SUPPORTED_PREDICATES = ("predicates",)
+SUPPORTED_OVERUSED = ("proportion",)
+SUPPORTED_JOB_READY = ("gang",)
+
+
+class EncoderFallback(Exception):
+    """The session uses a construct the batch kernel does not model; the
+    caller must run the serial oracle loop."""
+
+
+def _enabled_plugins(ssn, flag_name: str, fns: Dict) -> List[str]:
+    """Plugin names with a registered fn and an enabled flag, in tier order
+    (mirrors Session._tier_plugins)."""
+    out = []
+    for tier in ssn.tiers:
+        for plugin in tier.plugins:
+            if flag_name is not None and not conf.enabled(getattr(plugin, flag_name)):
+                continue
+            if plugin.name in fns:
+                out.append(plugin.name)
+    return out
+
+
+def _plugin_args(ssn, name: str):
+    from volcano_tpu.scheduler.framework.arguments import Arguments
+
+    for tier in ssn.tiers:
+        for plugin in tier.plugins:
+            if plugin.name == name:
+                return Arguments(plugin.arguments)
+    return Arguments({})
+
+
+@dataclass
+class EncodedSnapshot:
+    spec: SolveSpec
+    arrays: Dict[str, np.ndarray]
+    # decode maps
+    task_infos: List[TaskInfo] = field(default_factory=list)
+    job_infos: List[JobInfo] = field(default_factory=list)
+    node_names: List[str] = field(default_factory=list)
+    num_to_find: int = 0
+    rr0: int = 0
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (
+            len(self.task_infos),
+            len(self.node_names),
+            len(self.job_infos),
+            self.arrays["queue_deserved"].shape[0],
+            self.arrays["ns_active0"].shape[0],
+            self.arrays["sig_mask"].shape[0],
+        )
+
+
+def _signature_key(pod: Optional[objects.Pod]) -> str:
+    if pod is None:
+        return "<none>"
+    parts = [repr(sorted(pod.spec.node_selector.items()))]
+    aff = pod.spec.affinity
+    if aff is not None and aff.node_affinity is not None:
+        parts.append(repr([_term_repr(t) for t in aff.node_affinity.required_terms]))
+        parts.append(
+            repr([(p.weight, _term_repr(p.preference)) for p in aff.node_affinity.preferred_terms])
+        )
+    parts.append(repr([(t.key, t.operator, t.value, t.effect) for t in pod.spec.tolerations]))
+    return "|".join(parts)
+
+
+def _term_repr(term) -> str:
+    return repr(getattr(term, "match_expressions", term))
+
+
+def _has_pod_affinity(pod: Optional[objects.Pod]) -> bool:
+    if pod is None or pod.spec.affinity is None:
+        return False
+    a = pod.spec.affinity
+    return a.pod_affinity is not None or a.pod_anti_affinity is not None
+
+
+def _has_host_ports(pod: Optional[objects.Pod]) -> bool:
+    if pod is None:
+        return False
+    return any(p.host_port > 0 for c in pod.spec.containers for p in c.ports)
+
+
+def _static_node_ok(node: NodeInfo, memory_p: bool, disk_p: bool, pid_p: bool) -> bool:
+    """Task-independent predicate parts (predicates.py lines on node
+    conditions / unschedulable / pressure)."""
+    if not predicates_mod._node_condition(node, "Ready"):
+        return False
+    if predicates_mod._node_condition(node, "NetworkUnavailable"):
+        return False
+    if node.node is not None and node.node.spec.unschedulable:
+        return False
+    if memory_p and predicates_mod._node_condition(node, "MemoryPressure"):
+        return False
+    if disk_p and predicates_mod._node_condition(node, "DiskPressure"):
+        return False
+    if pid_p and predicates_mod._node_condition(node, "PIDPressure"):
+        return False
+    return True
+
+
+def _resource_vec(res: Resource, names: List[str]) -> np.ndarray:
+    return np.array([res.get(n) for n in names], np.float64)
+
+
+def encode_session(ssn) -> EncodedSnapshot:
+    """Build the dense solve inputs from a live session.
+
+    Raises EncoderFallback when the session cannot be modeled; the allocate
+    action then runs its serial loop (the parity oracle).
+    """
+    from volcano_tpu.scheduler.util import scheduler_helper
+
+    # ---- capability checks -------------------------------------------------
+    ns_order = _enabled_plugins(ssn, "enabled_namespace_order", ssn.namespace_order_fns)
+    if any(p != "drf" for p in ns_order):
+        raise EncoderFallback(f"unsupported namespace-order plugins: {ns_order}")
+    if ssn.node_map_fns or ssn.node_reduce_fns:
+        raise EncoderFallback("node map/reduce fns are not modeled")
+
+    job_order = _enabled_plugins(ssn, "enabled_job_order", ssn.job_order_fns)
+    if any(p not in SUPPORTED_JOB_ORDER for p in job_order):
+        raise EncoderFallback(f"unsupported job-order plugins: {job_order}")
+    queue_order = _enabled_plugins(ssn, "enabled_queue_order", ssn.queue_order_fns)
+    if any(p not in SUPPORTED_QUEUE_ORDER for p in queue_order):
+        raise EncoderFallback(f"unsupported queue-order plugins: {queue_order}")
+    node_order = _enabled_plugins(ssn, "enabled_node_order", ssn.node_order_fns)
+    if any(p not in SUPPORTED_NODE_ORDER for p in node_order):
+        raise EncoderFallback(f"unsupported node-order plugins: {node_order}")
+    predicates_on = _enabled_plugins(ssn, "enabled_predicate", ssn.predicate_fns)
+    if any(p not in SUPPORTED_PREDICATES for p in predicates_on):
+        raise EncoderFallback(f"unsupported predicate plugins: {predicates_on}")
+    overused = _enabled_plugins(ssn, None, ssn.overused_fns)
+    if any(p not in SUPPORTED_OVERUSED for p in overused):
+        raise EncoderFallback(f"unsupported overused plugins: {overused}")
+    job_ready = _enabled_plugins(ssn, "enabled_job_ready", ssn.job_ready_fns)
+    if any(p not in SUPPORTED_JOB_READY for p in job_ready):
+        raise EncoderFallback(f"unsupported job-ready plugins: {job_ready}")
+    batch_order = _enabled_plugins(ssn, "enabled_node_order", ssn.batch_node_order_fns)
+    if any(p not in ("nodeorder",) for p in batch_order):
+        raise EncoderFallback(f"unsupported batch-node-order plugins: {batch_order}")
+
+    # ---- node axis (name-sorted, = util.get_node_list order) ---------------
+    node_names = sorted(ssn.nodes)
+    nodes = [ssn.nodes[n] for n in node_names]
+    n_count = len(nodes)
+    for node in nodes:
+        if not node.releasing.is_empty():
+            raise EncoderFallback("releasing resources (pipeline path) not modeled")
+        for t in node.tasks.values():
+            if _has_pod_affinity(t.pod):
+                raise EncoderFallback("pod (anti-)affinity not modeled")
+            if _has_host_ports(t.pod):
+                raise EncoderFallback("host ports not modeled")
+
+    # ---- eligible jobs (allocate.go:49-76 filter) --------------------------
+    jobs: List[JobInfo] = []
+    for job in ssn.jobs.values():
+        if job.pod_group is None or job.pod_group.status.phase == objects.PodGroupPhase.PENDING:
+            continue
+        vr = ssn.job_valid(job)
+        if vr is not None and not vr.pass_:
+            continue
+        if job.queue not in ssn.queues:
+            continue
+        jobs.append(job)
+    j_count = len(jobs)
+
+    # resource dimensionality: cpu, memory + every scalar seen
+    scalar_names: set = set()
+    for job in jobs:
+        for task in job.tasks.values():
+            for res in (task.resreq, task.init_resreq):
+                scalar_names.update(res.scalar_resources or {})
+    for node in nodes:
+        scalar_names.update(node.allocatable.scalar_resources or {})
+    rnames = ["cpu", "memory", *sorted(scalar_names)]
+    R = len(rnames)
+    eps = np.array(
+        [MIN_MILLI_CPU, MIN_MEMORY] + [MIN_MILLI_SCALAR] * (R - 2), np.float64
+    )
+    is_scalar = np.array([False, False] + [True] * (R - 2))
+
+    # ---- flat task axis ----------------------------------------------------
+    task_infos: List[TaskInfo] = []
+    job_task_start = np.zeros(j_count, np.int32)
+    job_task_count = np.zeros(j_count, np.int32)
+    sig_index: Dict[str, int] = {}
+    sig_rep: List[TaskInfo] = []
+    task_sig: List[int] = []
+
+    def order_key(a: TaskInfo, b: TaskInfo) -> int:
+        return -1 if ssn.task_order_fn(a, b) else (1 if ssn.task_order_fn(b, a) else 0)
+
+    for ji, job in enumerate(jobs):
+        pending = [
+            t
+            for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
+            if not t.resreq.is_empty()
+        ]
+        pending.sort(key=cmp_to_key(order_key))
+        job_task_start[ji] = len(task_infos)
+        job_task_count[ji] = len(pending)
+        for t in pending:
+            if _has_pod_affinity(t.pod):
+                raise EncoderFallback("pod (anti-)affinity not modeled")
+            if _has_host_ports(t.pod):
+                raise EncoderFallback("host ports not modeled")
+            key = _signature_key(t.pod)
+            if key not in sig_index:
+                sig_index[key] = len(sig_rep)
+                sig_rep.append(t)
+            task_sig.append(sig_index[key])
+            task_infos.append(t)
+    t_count = len(task_infos)
+    s_count = max(len(sig_rep), 1)
+
+    task_req = np.zeros((t_count, R), np.float64)
+    task_initreq = np.zeros((t_count, R), np.float64)
+    task_nz_cpu = np.zeros(t_count, np.float64)
+    task_nz_mem = np.zeros(t_count, np.float64)
+    for ti, t in enumerate(task_infos):
+        task_req[ti] = _resource_vec(t.resreq, rnames)
+        task_initreq[ti] = _resource_vec(t.init_resreq, rnames)
+        task_nz_cpu[ti] = t.resreq.milli_cpu if t.resreq.milli_cpu != 0 else nodeorder_mod.DEFAULT_MILLI_CPU_REQUEST
+        task_nz_mem[ti] = t.resreq.memory if t.resreq.memory != 0 else nodeorder_mod.DEFAULT_MEMORY_REQUEST
+
+    # ---- static predicate masks per signature ------------------------------
+    pred_args = _plugin_args(ssn, "predicates")
+    memory_p = pred_args.get_bool(predicates_mod.MEMORY_PRESSURE_PREDICATE, False)
+    disk_p = pred_args.get_bool(predicates_mod.DISK_PRESSURE_PREDICATE, False)
+    pid_p = pred_args.get_bool(predicates_mod.PID_PRESSURE_PREDICATE, False)
+    check_pod_count = bool(predicates_on)
+
+    sig_mask = np.ones((s_count, n_count), bool)
+    if predicates_on:
+        node_ok = np.array(
+            [_static_node_ok(n, memory_p, disk_p, pid_p) for n in nodes]
+        )
+        for si, rep in enumerate(sig_rep):
+            pod = rep.pod
+            if pod is None:
+                sig_mask[si] = node_ok
+                continue
+            row = np.array(
+                [
+                    predicates_mod.pod_matches_node_selector(pod, n)
+                    and predicates_mod.tolerates_taints(pod, n)
+                    for n in nodes
+                ]
+            )
+            sig_mask[si] = node_ok & row
+
+    # ---- static preferred node-affinity score per signature ----------------
+    affinity_score = np.zeros((s_count, n_count), np.float64)
+    use_nodeorder = "nodeorder" in node_order
+    if use_nodeorder:
+        for si, rep in enumerate(sig_rep):
+            pod = rep.pod
+            if pod is None or pod.spec.affinity is None or pod.spec.affinity.node_affinity is None:
+                continue
+            if pod.spec.affinity.node_affinity.preferred_terms:
+                affinity_score[si] = [
+                    nodeorder_mod.node_affinity_score(rep, n) for n in nodes
+                ]
+
+    # ---- node state --------------------------------------------------------
+    node_idle = np.stack([_resource_vec(n.idle, rnames) for n in nodes]) if nodes else np.zeros((0, R))
+    node_used = np.stack([_resource_vec(n.used, rnames) for n in nodes]) if nodes else np.zeros((0, R))
+    node_alloc = np.stack([_resource_vec(n.allocatable, rnames) for n in nodes]) if nodes else np.zeros((0, R))
+    node_cnt = np.array([len(n.tasks) for n in nodes], np.int32)
+    node_max_tasks = np.array([n.allocatable.max_task_num for n in nodes], np.int32)
+
+    # ---- queues / namespaces ----------------------------------------------
+    ns_names = sorted({job.namespace for job in jobs})
+    ns_index = {n: i for i, n in enumerate(ns_names)}
+    ns_count = max(len(ns_names), 1)
+
+    queue_ids = sorted(
+        {job.queue for job in jobs},
+        key=lambda q: (ssn.queues[q].queue.metadata.creation_timestamp, ssn.queues[q].uid),
+    )
+    q_index = {q: i for i, q in enumerate(queue_ids)}
+    q_count = max(len(queue_ids), 1)
+
+    q_in_ns = np.zeros((ns_count, q_count), bool)
+    for job in jobs:
+        q_in_ns[ns_index[job.namespace], q_index[job.queue]] = True
+
+    queue_deserved = np.zeros((q_count, R), np.float64)
+    queue_present = np.zeros((q_count, R), bool)
+    queue_alloc0 = np.zeros((q_count, R), np.float64)
+    prop = ssn.plugins.get("proportion")
+    if prop is not None:
+        for q, qi in q_index.items():
+            attr = prop.queue_opts.get(q)
+            if attr is None:
+                continue
+            queue_deserved[qi] = _resource_vec(attr.deserved, rnames)
+            queue_alloc0[qi] = _resource_vec(attr.allocated, rnames)
+            present = {"cpu", "memory", *(attr.deserved.scalar_resources or {})}
+            queue_present[qi] = [rn in present for rn in rnames]
+
+    # ---- job arrays --------------------------------------------------------
+    job_queue = np.array([q_index[j.queue] for j in jobs], np.int32) if jobs else np.zeros(0, np.int32)
+    job_ns = np.array([ns_index[j.namespace] for j in jobs], np.int32) if jobs else np.zeros(0, np.int32)
+    job_priority = np.array([j.priority for j in jobs], np.int32) if jobs else np.zeros(0, np.int32)
+    job_min_available = np.array([j.min_available for j in jobs], np.int32) if jobs else np.zeros(0, np.int32)
+    job_ready_base = np.array([j.ready_task_num() for j in jobs], np.int32) if jobs else np.zeros(0, np.int32)
+    gang_ready_gate = "gang" in job_ready
+    job_ready_threshold = job_min_available if gang_ready_gate else np.zeros(j_count, np.int32)
+
+    order = sorted(range(j_count), key=lambda i: (jobs[i].creation_timestamp, jobs[i].uid))
+    job_tie_rank = np.zeros(j_count, np.int32)
+    for rank, i in enumerate(order):
+        job_tie_rank[i] = rank
+
+    job_alloc0 = np.zeros((j_count, R), np.float64)
+    drf = ssn.plugins.get("drf")
+    drf_total = np.zeros(R, np.float64)
+    drf_present = np.zeros(R, bool)
+    ns_alloc0 = np.zeros((ns_count, R), np.float64)
+    ns_weight = np.ones(ns_count, np.float64)
+    if drf is not None:
+        for ji, job in enumerate(jobs):
+            attr = drf.job_attrs.get(job.uid)
+            if attr is not None:
+                job_alloc0[ji] = _resource_vec(attr.allocated, rnames)
+        drf_total = _resource_vec(drf.total_resource, rnames)
+        present = {"cpu", "memory", *(drf.total_resource.scalar_resources or {})}
+        drf_present = np.array([rn in present for rn in rnames])
+        for name, i in ns_index.items():
+            opt = drf.namespace_opts.get(name)
+            if opt is not None:
+                ns_alloc0[i] = _resource_vec(opt.allocated, rnames)
+            info = ssn.namespace_info.get(name)
+            ns_weight[i] = info.get_weight() if info is not None else 1.0
+
+    # ---- score weights -----------------------------------------------------
+    binpack_w = np.zeros(R, np.float64)
+    binpack_weight = 0.0
+    use_binpack = "binpack" in node_order
+    if use_binpack:
+        bp = ssn.plugins.get("binpack")
+        w = bp.weight
+        if w.binpacking_weight == 0:
+            use_binpack = False
+        else:
+            binpack_weight = float(w.binpacking_weight)
+            for ri, rn in enumerate(rnames):
+                if rn == "cpu":
+                    binpack_w[ri] = w.binpacking_cpu
+                elif rn == "memory":
+                    binpack_w[ri] = w.binpacking_memory
+                elif rn in w.binpacking_resources:
+                    binpack_w[ri] = w.binpacking_resources[rn]
+
+    no_args = _plugin_args(ssn, "nodeorder")
+    least_req_weight = float(no_args.get_int(nodeorder_mod.LEAST_REQUESTED_WEIGHT, 1))
+    balanced_weight = float(no_args.get_int(nodeorder_mod.BALANCED_RESOURCE_WEIGHT, 1))
+    node_affinity_weight = float(no_args.get_int(nodeorder_mod.NODE_AFFINITY_WEIGHT, 1))
+
+    spec = SolveSpec(
+        job_order_keys=tuple(job_order),
+        use_drf_ns_order=bool(ns_order),
+        use_prop_queue_order=bool(queue_order),
+        use_prop_overused=bool(overused),
+        check_pod_count=check_pod_count,
+        use_binpack=use_binpack,
+        use_nodeorder=use_nodeorder,
+        max_visits=ns_count + j_count + t_count + 8,
+    )
+
+    arrays = dict(
+        eps=eps,
+        is_scalar=is_scalar,
+        task_req=task_req,
+        task_initreq=task_initreq,
+        task_nz_cpu=task_nz_cpu,
+        task_nz_mem=task_nz_mem,
+        task_sig=np.array(task_sig, np.int32) if task_sig else np.zeros(0, np.int32),
+        sig_mask=sig_mask,
+        affinity_score=affinity_score,
+        node_idle=node_idle.astype(np.float64),
+        node_used=node_used.astype(np.float64),
+        node_alloc=node_alloc.astype(np.float64),
+        node_cnt=node_cnt,
+        node_max_tasks=node_max_tasks,
+        node_real=np.ones(n_count, bool),
+        real_n=np.int32(n_count),
+        job_task_start=job_task_start,
+        job_task_count=job_task_count,
+        job_queue=job_queue,
+        job_ns=job_ns,
+        job_priority=job_priority,
+        job_min_available=job_min_available,
+        job_ready_base=job_ready_base,
+        job_ready_threshold=job_ready_threshold.astype(np.int32),
+        job_tie_rank=job_tie_rank,
+        job_alloc0=job_alloc0,
+        job_active0=np.ones(j_count, bool),
+        queue_deserved=queue_deserved,
+        queue_present=queue_present,
+        queue_alloc0=queue_alloc0,
+        queue_tie_rank=np.arange(q_count, dtype=np.int32),
+        q_in_ns0=q_in_ns,
+        ns_active0=np.array([i < len(ns_names) for i in range(ns_count)]),
+        ns_rank=np.arange(ns_count, dtype=np.int32),
+        ns_alloc0=ns_alloc0,
+        ns_weight=ns_weight,
+        drf_total=drf_total,
+        drf_present=drf_present,
+        binpack_w=binpack_w,
+        binpack_weight=np.float64(binpack_weight),
+        least_req_weight=np.float64(least_req_weight),
+        balanced_weight=np.float64(balanced_weight),
+        node_affinity_weight=np.float64(node_affinity_weight),
+    )
+
+    enc = EncodedSnapshot(
+        spec=spec,
+        arrays=arrays,
+        task_infos=task_infos,
+        job_infos=jobs,
+        node_names=node_names,
+        num_to_find=scheduler_helper.calculate_num_of_feasible_nodes_to_find(n_count),
+        rr0=scheduler_helper._last_processed_node_index,
+    )
+    return enc

@@ -1,0 +1,372 @@
+"""The fused allocate kernel.
+
+Emulates the serial allocate action (reference: volcano
+pkg/scheduler/actions/allocate/allocate.go:42-247) as one `lax.while_loop`
+over scheduling *visits*. Each visit:
+
+1. selects the namespace (static rank — the reference's namespace heap with
+   static keys drains one namespace before the next);
+2. selects the queue: permanently drops overused queues (proportion plugin,
+   proportion.go:201-212), then lexicographic argmin on (share, creation
+   rank) (allocate.go:134-146);
+3. selects the job: lexicographic argmin over enabled job-order keys in tier
+   order — priority, gang non-ready-first, DRF share — with (creation, uid)
+   rank as the final tie-break (framework/session_plugins.go:287-303);
+4. runs the inner task loop: N-wide feasibility (static signature mask ∧
+   epsilon resource fit ∧ pod-count), the reference's adaptive node-sampling
+   window (scheduler_helper.go:42-118, round-robin start index included),
+   fused binpack+nodeorder scoring, deterministic argmax (lowest node index =
+   lexicographically smallest node name — nodes are name-sorted on encode);
+5. commits the visit when the gang is ready (statement.go:325) or rolls all
+   tentative placements back (statement.go:309) — idle/used/pod-count
+   snapshots restore in O(N*R).
+
+All state lives in a carry of dense arrays; nothing is data-dependently
+shaped, so the whole session solve is one XLA program. The node axis (N) of
+every array can be sharded across a `jax.sharding.Mesh`; the selection
+reductions become ICI collectives inserted by GSPMD.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Parity-critical constants imported from their canonical homes so device
+# feasibility can never desynchronize from host Resource.less_equal.
+from volcano_tpu.api.resource import (  # noqa: F401 (re-exported for kernels)
+    MIN_MEMORY,
+    MIN_MILLI_CPU,
+    MIN_MILLI_SCALAR,
+)
+
+MAX_PRIORITY = 10.0
+
+_BIG_I32 = jnp.iinfo(jnp.int32).max
+
+
+def _lex_argmin(valid, keys):
+    """Index of the valid element minimizing `keys` lexicographically.
+
+    Mirrors the priority-queue comparators: first non-equal key decides;
+    ties impossible past the last (unique-rank) key. Returns (idx, any).
+    """
+    mask = valid
+    for k in keys:
+        if jnp.issubdtype(k.dtype, jnp.floating):
+            sentinel = jnp.array(jnp.inf, k.dtype)
+        else:
+            sentinel = jnp.array(jnp.iinfo(k.dtype).max, k.dtype)
+        kv = jnp.where(mask, k, sentinel)
+        mask = mask & (kv == jnp.min(kv))
+    return jnp.argmax(mask), jnp.any(valid)
+
+
+def _fits(req, avail, eps, is_scalar):
+    """Per-node epsilon feasibility of `req` [R] against `avail` [N, R]
+    (resource_info.go:267-301: scalar dims <= 10 milli are skipped)."""
+    le = req[None, :] < avail + eps[None, :]
+    skip = is_scalar[None, :] & (req[None, :] <= MIN_MILLI_SCALAR)
+    return jnp.all(le | skip, axis=-1)
+
+
+def _le_eps(l, r, eps, is_scalar):
+    """Vectorized Resource.less_equal over rows: l, r are [..., R]."""
+    le = l < r + eps
+    skip = is_scalar & (l <= MIN_MILLI_SCALAR)
+    return jnp.all(le | skip, axis=-1)
+
+
+def _share(alloc, total, present):
+    """max_r alloc_r/total_r over present dims, with share(l, 0) = 1 when
+    l != 0 (api/share_helpers.py; drf.go:299-311 / proportion.go:44-52)."""
+    s = jnp.where(total > 0, alloc / jnp.where(total > 0, total, 1.0),
+                  jnp.where(alloc == 0, 0.0, 1.0))
+    return jnp.max(jnp.where(present, s, -jnp.inf), axis=-1, initial=0.0)
+
+
+def _sample_window(mask, node_real, real_n, rr, num_to_find):
+    """The reference's round-robin feasible-node window
+    (scheduler_helper.go:64-118): starting at `rr`, keep the first
+    `num_to_find` feasible nodes; report how many *real* nodes were examined.
+
+    The node axis may be padded for mesh divisibility; padded slots are never
+    feasible and are excluded from the examined count, so the circular order
+    and round-robin arithmetic over the real nodes match the serial helper
+    exactly (the pad block sits between real index N-1 and 0 and cannot
+    reorder real nodes).
+
+    Returns (selected mask, processed real-node count, found any).
+    """
+    rolled = jnp.roll(mask, -rr)
+    rolled_real = jnp.roll(node_real, -rr).astype(jnp.int32)
+    c = jnp.cumsum(rolled.astype(jnp.int32))
+    found_total = c[-1]
+    sel_rolled = rolled & (c <= num_to_find)
+    # index of the num_to_find-th feasible node (first index where c == K)
+    kth = jnp.argmax(c >= num_to_find)
+    examined = jnp.cumsum(rolled_real)
+    processed = jnp.where(found_total >= num_to_find, examined[kth], real_n)
+    sel = jnp.roll(sel_rolled, rr)
+    return sel, processed, found_total > 0
+
+
+class SolveSpec(NamedTuple):
+    """Static (trace-time) solve configuration — part of the jit key."""
+
+    # enabled job-order plugins IN TIER ORDER (the dispatch is first-nonzero
+    # across tiers, session_plugins.go:287-303, so ordering is semantic)
+    job_order_keys: tuple
+    use_drf_ns_order: bool
+    use_prop_queue_order: bool
+    use_prop_overused: bool
+    check_pod_count: bool
+    use_binpack: bool
+    use_nodeorder: bool
+    max_visits: int
+
+
+def _node_score(spec: SolveSpec, st, enc, t):
+    """Fused per-node score for task t: binpack + nodeorder
+    (binpack.go:201-261, nodeorder.go:161-200). Returns [N] float."""
+    used = st["used"]          # [N, R]
+    alloc = enc["node_alloc"]  # [N, R] allocatable
+    req = enc["task_req"][t]   # [R]
+    score = jnp.zeros(used.shape[0], used.dtype)
+
+    if spec.use_nodeorder:
+        nz_cpu = enc["task_nz_cpu"][t]
+        nz_mem = enc["task_nz_mem"][t]
+        cap_cpu, cap_mem = alloc[:, 0], alloc[:, 1]
+        want_cpu = used[:, 0] + nz_cpu
+        want_mem = used[:, 1] + nz_mem
+
+        def dim(cap, want):
+            ok = (cap > 0) & (want <= cap)
+            return jnp.where(ok, (cap - want) * MAX_PRIORITY / jnp.where(cap > 0, cap, 1.0), 0.0)
+
+        least = jnp.floor((dim(cap_cpu, want_cpu) + dim(cap_mem, want_mem)) / 2.0)
+
+        cpu_frac = want_cpu / jnp.where(cap_cpu > 0, cap_cpu, 1.0)
+        mem_frac = want_mem / jnp.where(cap_mem > 0, cap_mem, 1.0)
+        bal_ok = (cap_cpu > 0) & (cap_mem > 0) & (cpu_frac < 1.0) & (mem_frac < 1.0)
+        balanced = jnp.where(
+            bal_ok,
+            jnp.floor(MAX_PRIORITY - jnp.abs(cpu_frac - mem_frac) * MAX_PRIORITY),
+            0.0,
+        )
+        score = score + least * enc["least_req_weight"] + balanced * enc["balanced_weight"]
+        # static preferred node-affinity score, per signature
+        score = score + enc["affinity_score"][enc["task_sig"][t]] * enc["node_affinity_weight"]
+
+    if spec.use_binpack:
+        # per-dim weights zeroed where the task requests nothing
+        w_eff = jnp.where(req > 0, enc["binpack_w"], 0.0)  # [R]
+        w_sum = jnp.sum(w_eff)
+        want = req[None, :] + used                          # [N, R]
+        ok = (alloc > 0) & (want <= alloc)
+        part = jnp.where(ok, want * w_eff[None, :] / jnp.where(alloc > 0, alloc, 1.0), 0.0)
+        raw = jnp.sum(part, axis=-1)
+        bp = jnp.where(w_sum > 0, raw / jnp.where(w_sum > 0, w_sum, 1.0), 0.0)
+        score = score + bp * MAX_PRIORITY * enc["binpack_weight"]
+
+    return score
+
+
+def _job_keys(spec: SolveSpec, st, enc):
+    """Job-order key arrays [J], in the configured tier order, with the
+    (creation, uid) rank as final tie-break (session.go job_order_fn)."""
+    keys = []
+    for name in spec.job_order_keys:
+        if name == "priority":
+            keys.append(-enc["job_priority"])
+        elif name == "gang":
+            ready = (enc["job_ready_base"] + st["job_placed"]) >= enc["job_min_available"]
+            keys.append(ready.astype(jnp.int32))  # non-ready (0) first
+        elif name == "drf":
+            keys.append(_share(st["job_alloc"], enc["drf_total"][None, :],
+                               enc["drf_present"][None, :]))
+    keys.append(enc["job_tie_rank"])
+    return keys
+
+
+def _queue_share(st, enc):
+    return _share(st["queue_alloc"], enc["queue_deserved"], enc["queue_present"])
+
+
+def _inner_task_loop(spec: SolveSpec, enc, st, j):
+    """Place tasks of job j until gang-ready / exhausted / infeasible
+    (allocate.go:160-243). Returns the updated tentative state."""
+    start = enc["job_task_start"][j]
+    count = enc["job_task_count"][j]
+    # min_available when the gang job-ready gate is enabled, else 0 (job_ready
+    # is then trivially true and each visit commits after one placement)
+    threshold = enc["job_ready_threshold"][j]
+    base = enc["job_ready_base"][j] + st["job_placed"][j]
+    eps = enc["eps"]
+    is_scalar = enc["is_scalar"]
+
+    def cond(c):
+        return (c["ptr"] < count) & ~c["broke"] & ~c["infeasible"]
+
+    def body(c):
+        t = start + c["ptr"]
+        sig = enc["task_sig"][t]
+        fit = _fits(enc["task_initreq"][t], c["idle"], eps, is_scalar)
+        mask = enc["sig_mask"][sig] & fit
+        if spec.check_pod_count:
+            mask = mask & (c["cnt"] < enc["node_max_tasks"])
+        sel, processed, found = _sample_window(
+            mask, enc["node_real"], enc["real_n"], c["rr"], enc["num_to_find"])
+        rr = ((c["rr"] + processed) % enc["real_n"]).astype(jnp.int32)
+
+        def place(c):
+            score = _node_score(spec, {"used": c["used"]}, enc, t)
+            neg = jnp.array(-jnp.inf, score.dtype)
+            n = jnp.argmax(jnp.where(sel, score, neg))
+            req = enc["task_req"][t]
+            idle = c["idle"].at[n].add(-req)
+            used = c["used"].at[n].add(req)
+            cnt = c["cnt"].at[n].add(1)
+            assign = c["assign"].at[t].set(n.astype(jnp.int32))
+            placed = c["placed"] + 1
+            broke = (base + placed) >= threshold
+            return dict(c, idle=idle, used=used, cnt=cnt, assign=assign,
+                        placed=placed, placed_req=c["placed_req"] + req,
+                        ptr=c["ptr"] + 1, rr=rr, broke=broke)
+
+        def abort(c):
+            return dict(c, infeasible=True, rr=rr)
+
+        return lax.cond(found, place, abort, c)
+
+    init = dict(
+        ptr=st["job_ptr"][j] - start,  # resume where the last visit stopped
+        placed=jnp.int32(0),
+        placed_req=jnp.zeros_like(enc["eps"]),
+        idle=st["idle"], used=st["used"], cnt=st["cnt"], assign=st["assign"],
+        rr=st["rr"],
+        broke=jnp.bool_(False),
+        infeasible=jnp.bool_(False),
+    )
+    return lax.while_loop(cond, body, init)
+
+
+def _make_visit(spec: SolveSpec, enc):
+    def visit(st):
+        # 1. namespace: weighted DRF share when enabled (drf.go:223-252),
+        # else static name rank (heap with static keys drains in order)
+        ns_keys = []
+        if spec.use_drf_ns_order:
+            ns_share = _share(st["ns_alloc"], enc["drf_total"][None, :],
+                              enc["drf_present"][None, :])
+            ns_keys.append(ns_share / enc["ns_weight"])
+        ns_keys.append(enc["ns_rank"])
+        ns, _ = _lex_argmin(st["ns_active"], ns_keys)
+
+        # 2. queue, purging overused queues permanently
+        q_in = st["q_in_ns"][ns]
+        if spec.use_prop_overused:
+            overused = ~_le_eps(st["queue_alloc"], enc["queue_deserved"],
+                                enc["eps"][None, :], enc["is_scalar"][None, :])
+            q_in = q_in & ~overused
+        q_in_ns = st["q_in_ns"].at[ns].set(q_in)
+        q_keys = []
+        if spec.use_prop_queue_order:
+            q_keys.append(_queue_share(st, enc))
+        q_keys.append(enc["queue_tie_rank"])
+        q, q_any = _lex_argmin(q_in, q_keys)
+
+        # 3. job
+        j_valid = st["job_active"] & (enc["job_queue"] == q) & (enc["job_ns"] == ns)
+        j, j_any = _lex_argmin(j_valid, _job_keys(spec, st, enc))
+
+        def drop_ns(st):
+            # all queues overused / selected queue empty: the namespace is
+            # popped and never re-pushed (allocate.go:125-157 continue paths)
+            return dict(st, ns_active=st["ns_active"].at[ns].set(False),
+                        q_in_ns=q_in_ns, visits=st["visits"] + 1)
+
+        def process(st):
+            c = _inner_task_loop(spec, enc, dict(st, q_in_ns=q_in_ns), j)
+            ready = (enc["job_ready_base"][j] + st["job_placed"][j] + c["placed"]
+                     ) >= enc["job_ready_threshold"][j]
+
+            def commit(_):
+                job_alloc = st["job_alloc"].at[j].add(c["placed_req"])
+                queue_alloc = st["queue_alloc"].at[q].add(c["placed_req"])
+                ns_alloc = st["ns_alloc"].at[ns].add(c["placed_req"])
+                job_placed = st["job_placed"].at[j].add(c["placed"])
+                job_ptr = st["job_ptr"].at[j].set(
+                    enc["job_task_start"][j] + c["ptr"])
+                # re-pushed only on the gang-ready break (allocate.go:238-240)
+                active = st["job_active"].at[j].set(c["broke"])
+                return dict(
+                    st, idle=c["idle"], used=c["used"], cnt=c["cnt"],
+                    assign=c["assign"], rr=c["rr"],
+                    job_alloc=job_alloc, queue_alloc=queue_alloc,
+                    ns_alloc=ns_alloc,
+                    job_placed=job_placed, job_ptr=job_ptr, job_active=active,
+                    q_in_ns=q_in_ns, visits=st["visits"] + 1,
+                )
+
+            def discard(_):
+                # roll tentative placements back (statement.go:309-322)
+                start = enc["job_task_start"][j]
+                t_idx = jnp.arange(enc["task_req"].shape[0], dtype=jnp.int32)
+                tent = (t_idx >= start + (c["ptr"] - c["placed"])) & (t_idx < start + c["ptr"])
+                assign = jnp.where(tent, -1, c["assign"])
+                active = st["job_active"].at[j].set(False)
+                return dict(st, assign=assign, rr=c["rr"],
+                            job_active=active, q_in_ns=q_in_ns,
+                            visits=st["visits"] + 1)
+
+            return lax.cond(ready, commit, discard, None)
+
+        def have_job(st):
+            return process(st)
+
+        return lax.cond(q_any & j_any, have_job, drop_ns, st)
+
+    return visit
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def solve_allocate(spec: SolveSpec, enc: dict, rr0, num_to_find):
+    """Run the whole allocate session on device.
+
+    enc: dict of dense arrays from the encoder (see encoder.EncodedSnapshot
+    .device_dict()). Returns (assign [T] int32 node index or -1, rr final).
+    """
+    T = enc["task_req"].shape[0]
+    N = enc["node_idle"].shape[0]
+    enc = dict(enc, num_to_find=num_to_find)
+
+    st = dict(
+        idle=enc["node_idle"],
+        used=enc["node_used"],
+        cnt=enc["node_cnt"],
+        assign=jnp.full((T,), -1, jnp.int32),
+        rr=jnp.asarray(rr0, jnp.int32),
+        job_ptr=enc["job_task_start"],
+        job_placed=jnp.zeros_like(enc["job_task_start"]),
+        job_alloc=enc["job_alloc0"],
+        queue_alloc=enc["queue_alloc0"],
+        ns_alloc=enc["ns_alloc0"],
+        job_active=enc["job_active0"],
+        ns_active=enc["ns_active0"],
+        q_in_ns=enc["q_in_ns0"],
+        visits=jnp.int32(0),
+    )
+
+    visit = _make_visit(spec, enc)
+
+    def cond(st):
+        return jnp.any(st["ns_active"]) & (st["visits"] < spec.max_visits)
+
+    st = lax.while_loop(cond, visit, st)
+    return st["assign"], st["rr"]

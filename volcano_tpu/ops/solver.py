@@ -1,0 +1,213 @@
+"""Batch-allocator orchestration: encode -> pad -> device solve -> apply.
+
+The solver is a drop-in for the allocate action's serial sweep: the tpuscore
+plugin (volcano_tpu/scheduler/plugins/tpuscore.py) attaches a BatchAllocator
+to the session, and actions/allocate.py hands the whole placement pass to it.
+Placement decisions come back as a flat task->node assignment; they are
+applied through the normal Statement machinery (framework/statement.py) so
+event handlers, job status flips, and cache binding behave exactly as in the
+serial path. Commit authority stays on the host — the device solve is a pure
+function of the snapshot (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from volcano_tpu.ops import kernels
+from volcano_tpu.ops.encoder import EncodedSnapshot, EncoderFallback, encode_session
+
+logger = logging.getLogger(__name__)
+
+
+def _bucket(n: int) -> int:
+    """Next power-of-two-ish bucket to bound recompilations as task/job
+    counts churn between sessions (SURVEY.md §7: pad-to-bucket shapes)."""
+    if n <= 16:
+        return 16
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pad_axis(a: np.ndarray, axis: int, size: int, fill=0):
+    if a.shape[axis] == size:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, size - a.shape[axis])
+    return np.pad(a, widths, constant_values=fill)
+
+
+_NODE_AXIS = {
+    "sig_mask": 1, "affinity_score": 1,
+    "node_idle": 0, "node_used": 0, "node_alloc": 0,
+    "node_cnt": 0, "node_max_tasks": 0, "node_real": 0,
+}
+
+
+def pad_encoded(enc: EncodedSnapshot, node_multiple: int = 1) -> Dict[str, np.ndarray]:
+    """Pad the churny axes (tasks, jobs) to buckets. The node axis is padded
+    only up to `node_multiple` (mesh divisibility); padded node slots carry
+    sig_mask=False and node_real=False, so the kernel's sampling window
+    counts and selects over real nodes exactly as the serial helper does."""
+    t, n, j, q, ns, s = enc.shape
+    tb, jb = _bucket(t), _bucket(j)
+    a = dict(enc.arrays)
+    for name in ("task_req", "task_initreq", "task_nz_cpu", "task_nz_mem", "task_sig"):
+        a[name] = _pad_axis(a[name], 0, tb)
+    for name in (
+        "job_task_start", "job_task_count", "job_queue", "job_ns",
+        "job_priority", "job_min_available", "job_ready_base",
+        "job_ready_threshold", "job_alloc0",
+    ):
+        a[name] = _pad_axis(a[name], 0, jb)
+    # padded jobs must never win selection and padded tasks never place:
+    a["job_active0"] = _pad_axis(a["job_active0"], 0, jb, fill=False)
+    a["job_tie_rank"] = _pad_axis(a["job_tie_rank"], 0, jb, fill=np.iinfo(np.int32).max - 1)
+    if node_multiple > 1 and n % node_multiple:
+        nb = ((n + node_multiple - 1) // node_multiple) * node_multiple
+        for name, axis in _NODE_AXIS.items():
+            a[name] = _pad_axis(a[name], axis, nb, fill=False if name in ("sig_mask", "node_real") else 0)
+    return a
+
+
+class BatchAllocator:
+    """Callable attached to the session as ``ssn.batch_allocator``.
+
+    Returns True when the batched solve ran; False => the caller must run
+    the serial loop (EncoderFallback or no work to do).
+    """
+
+    def __init__(self, mesh=None, dtype=None, profile: Optional[dict] = None):
+        self.mesh = mesh
+        self.dtype = dtype
+        self.profile = profile if profile is not None else {}
+
+    def _cast(self, arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        dtype = self.dtype
+        if dtype is None:
+            import jax
+
+            dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+        out = {}
+        for k, v in arrays.items():
+            v = np.asarray(v)
+            out[k] = v.astype(dtype) if v.dtype == np.float64 else v
+        return out
+
+    def _shard(self, arrays: Dict[str, np.ndarray]) -> Dict[str, object]:
+        """Place node-axis arrays across the mesh; replicate the rest."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+        out = {}
+        for k, v in arrays.items():
+            if k in _NODE_AXIS and np.asarray(v).ndim > 0:
+                spec = [None] * np.asarray(v).ndim
+                spec[_NODE_AXIS[k]] = "nodes"
+                sh = NamedSharding(mesh, P(*spec))
+            else:
+                sh = NamedSharding(mesh, P())
+            out[k] = jax.device_put(v, sh)
+        return out
+
+    def __call__(self, ssn) -> bool:
+        from volcano_tpu.scheduler.util import scheduler_helper
+
+        t0 = time.perf_counter()
+        try:
+            enc = encode_session(ssn)
+        except EncoderFallback as e:
+            logger.info("tpuscore falling back to serial allocate: %s", e)
+            self.profile["fallback"] = str(e)
+            return False
+        t, n, j, *_ = enc.shape
+        if t == 0 or n == 0 or j == 0:
+            # nothing to place; serial loop is also a no-op but cheaper
+            return False
+
+        try:
+            node_multiple = 1
+            if self.mesh is not None:
+                node_multiple = int(np.prod(list(self.mesh.shape.values())))
+            arrays = self._cast(pad_encoded(enc, node_multiple))
+            if self.mesh is not None:
+                arrays = self._shard(arrays)
+            t1 = time.perf_counter()
+
+            assign, rr = kernels.solve_allocate(
+                enc.spec, arrays, np.int32(enc.rr0), np.int32(enc.num_to_find)
+            )
+            assign = np.asarray(assign)
+            rr = int(rr)
+        except Exception as e:  # any device/compile failure -> serial oracle
+            logger.exception("tpuscore solve failed; falling back to serial")
+            self.profile["fallback"] = f"solve error: {e}"
+            return False
+        t2 = time.perf_counter()
+
+        # round-robin index continues across sessions exactly like the serial
+        # helper (scheduler_helper.go:38)
+        scheduler_helper._last_processed_node_index = rr
+
+        self._apply(ssn, enc, assign)
+        t3 = time.perf_counter()
+        self.profile.update(
+            encode_s=t1 - t0, solve_s=t2 - t1, apply_s=t3 - t2,
+            tasks=t, nodes=n, jobs=j,
+            placed=int((assign[: len(enc.task_infos)] >= 0).sum()),
+        )
+        return True
+
+    def _apply(self, ssn, enc: EncodedSnapshot, assign: np.ndarray) -> None:
+        """Replay device placements through per-job statements; every
+        committed job is gang-ready by construction, so stmt.commit()
+        dispatches binds exactly as the serial path would."""
+        from volcano_tpu.api.unschedule_info import FitErrors
+
+        start = enc.arrays["job_task_start"]
+        count = enc.arrays["job_task_count"]
+        for ji, job in enumerate(enc.job_infos):
+            lo, hi = int(start[ji]), int(start[ji]) + int(count[ji])
+            placed = [
+                (ti, int(assign[ti])) for ti in range(lo, hi) if assign[ti] >= 0
+            ]
+            if len(placed) < hi - lo and not job.ready():
+                # the solve left this gang short: record a fit error for the
+                # first unplaced task so gang.on_session_close emits the same
+                # Unschedulable condition structure as the serial path
+                for ti in range(lo, hi):
+                    if assign[ti] < 0:
+                        fe = FitErrors()
+                        fe.set_error(
+                            "0/%d nodes are available in the batched "
+                            "feasibility/fit solve" % len(enc.node_names))
+                        job.nodes_fit_errors[enc.task_infos[ti].uid] = fe
+                        break
+            if not placed:
+                continue
+            stmt = ssn.statement()
+            ok = True
+            for ti, ni in placed:
+                task = enc.task_infos[ti]
+                try:
+                    stmt.allocate(task, enc.node_names[ni])
+                except (KeyError, RuntimeError) as e:  # pragma: no cover
+                    logger.error(
+                        "tpuscore apply failed for %s -> %s: %s",
+                        task.uid, enc.node_names[ni], e,
+                    )
+                    ok = False
+                    break
+            if ok and ssn.job_ready(job):
+                stmt.commit()
+            else:  # pragma: no cover - device decisions are gang-consistent
+                stmt.discard()

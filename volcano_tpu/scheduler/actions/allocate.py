@@ -36,8 +36,7 @@ class AllocateAction(Action):
         # this session, let it drive placement for the whole snapshot; the
         # serial loop below remains the fallback and oracle.
         solver = getattr(ssn, "batch_allocator", None)
-        if solver is not None:
-            solver(ssn)
+        if solver is not None and solver(ssn):
             return
         self._serial_execute(ssn)
 

@@ -10,6 +10,7 @@ from volcano_tpu.scheduler.plugins import (
     predicates,
     priority,
     proportion,
+    tpuscore,
 )
 
 register_plugin_builder("gang", gang.new)
@@ -20,3 +21,4 @@ register_plugin_builder("proportion", proportion.new)
 register_plugin_builder("predicates", predicates.new)
 register_plugin_builder("nodeorder", nodeorder.new)
 register_plugin_builder("binpack", binpack.new)
+register_plugin_builder("tpuscore", tpuscore.new)

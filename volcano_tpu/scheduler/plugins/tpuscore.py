@@ -1,0 +1,70 @@
+"""tpuscore — the TPU batch-solve gate (BASELINE.json north star).
+
+A session plugin (reference seam: volcano pkg/scheduler/framework/plugins.go
+RegisterPluginBuilder) that attaches a BatchAllocator to the session; the
+allocate action (actions/allocate.py) hands the whole placement pass to it
+and keeps the serial loop as fallback/oracle. With the plugin absent or
+``tpuscore.enable: "false"``, scheduling behavior is bit-identical to the
+serial path — the plugin API is the gate, exactly as the reference's design
+demands (the Go hot loop unchanged when the backend is off).
+
+Arguments:
+    tpuscore.enable: "true"/"false" (default true)
+    tpuscore.dtype:  "float32"/"float64" (default: float64 under jax x64,
+                     float32 otherwise; bf16 is rejected — memory-byte
+                     epsilons need >8 mantissa bits)
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from volcano_tpu.scheduler.framework.interface import Plugin
+
+logger = logging.getLogger(__name__)
+
+PLUGIN_NAME = "tpuscore"
+
+ENABLE = "tpuscore.enable"
+DTYPE = "tpuscore.dtype"
+
+_DTYPES = {"float32": np.float32, "float64": np.float64}
+
+
+class TpuScorePlugin(Plugin):
+    def __init__(self, arguments=None):
+        self.arguments = arguments or {}
+        self.profile: dict = {}
+        self.mesh = None  # settable by the scheduler driver for multi-chip
+
+    def name(self) -> str:
+        return PLUGIN_NAME
+
+    def on_session_open(self, ssn) -> None:
+        from volcano_tpu.scheduler.framework.arguments import Arguments
+
+        args = self.arguments if isinstance(self.arguments, Arguments) else Arguments(self.arguments)
+        if not args.get_bool(ENABLE, True):
+            return
+        from volcano_tpu.ops.solver import BatchAllocator
+
+        requested = str(args.get(DTYPE, ""))
+        dtype = _DTYPES.get(requested)
+        if requested and dtype is None:
+            logger.warning(
+                "tpuscore.dtype %r not supported (%s); using platform default",
+                requested, "/".join(_DTYPES),
+            )
+        ssn.batch_allocator = BatchAllocator(
+            mesh=self.mesh, dtype=dtype, profile=self.profile
+        )
+
+    def on_session_close(self, ssn) -> None:
+        if getattr(ssn, "batch_allocator", None) is not None:
+            ssn.batch_allocator = None
+
+
+def new(arguments):
+    return TpuScorePlugin(arguments)

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Benchmark driver: scheduler-session latency, serial loop vs TPU solve.
+
+Prints ONE final JSON line:
+    {"metric": "...", "value": N, "unit": "ms", "vs_baseline": N}
+
+- value: TPU-backend allocate-session latency (encode + device solve + apply)
+  at the headline config (BASELINE.json cfg 5: 50k tasks x 10k nodes), warm
+  (compile excluded — the scheduler reuses the compiled program every cycle).
+- vs_baseline: speedup over the serial oracle loop at the same config. The
+  reference publishes no numbers (BASELINE.md), so the baseline is the
+  serial path measured here; where the serial loop would take > --serial-budget
+  seconds it is measured at a reduced scale and extrapolated linearly in
+  (tasks x nodes), reported with "serial_extrapolated": true.
+
+Usage:
+    python bench.py                     # headline (cfg 5, full scale)
+    python bench.py --config 1 --scale 0.2 --backend both
+    python bench.py --all --scale 0.05  # all five configs, smoke scale
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _session_once(cache, tiers, actions, mesh=None):
+    """Open a session, run the actions, close; returns (latency_s, binds, profile)."""
+    import volcano_tpu.scheduler.actions  # noqa: F401 (register actions)
+    from volcano_tpu.scheduler.framework import close_session, get_action, open_session
+
+    t0 = time.perf_counter()
+    ssn = open_session(cache, tiers)
+    if mesh is not None and "tpuscore" in ssn.plugins:
+        ssn.plugins["tpuscore"].mesh = mesh
+        if getattr(ssn, "batch_allocator", None) is not None:
+            ssn.batch_allocator.mesh = mesh
+    t_open = time.perf_counter()
+    for name in actions:
+        get_action(name).execute(ssn)
+    t_act = time.perf_counter()
+    profile = dict(ssn.plugins["tpuscore"].profile) if "tpuscore" in ssn.plugins else {}
+    close_session(ssn)
+    return {
+        "open_s": t_open - t0,
+        "actions_s": t_act - t_open,
+        "binds": len(cache.binder.binds),
+        "profile": profile,
+    }
+
+
+def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
+               mesh=None, verbose=True):
+    from volcano_tpu.bench.clusters import CONFIGS, build_config
+
+    bc = CONFIGS[cfg]
+    out = {"config": cfg, "name": bc.name, "scale": scale}
+
+    if backend in ("serial", "both", "auto"):
+        # estimate serial cost before committing to it: measured at small
+        # scale, the serial loop is ~linear in placed-tasks x nodes
+        serial_scale = scale
+        est = None
+        if backend == "auto" or cfg >= 3:
+            probe_scale = min(scale, 0.02)
+            cache, st, _, actions, _ = build_config(cfg, probe_scale)
+            t0 = time.perf_counter()
+            probe = _session_once(cache, st, actions)
+            probe_s = time.perf_counter() - t0
+            unit = probe_scale * probe_scale  # tasks*nodes both scale
+            est = probe_s / unit * (scale * scale)
+            if est > serial_budget:
+                serial_scale = max((serial_budget / (probe_s / unit)) ** 0.5, probe_scale)
+        cache, serial_tiers, _, actions, n_tasks = build_config(cfg, serial_scale)
+        r = _session_once(cache, serial_tiers, actions)
+        serial_s = r["actions_s"]
+        if serial_scale < scale:
+            factor = (scale * scale) / (serial_scale * serial_scale)
+            out["serial_measured_scale"] = serial_scale
+            out["serial_measured_ms"] = serial_s * 1e3
+            serial_s = serial_s * factor
+            out["serial_extrapolated"] = True
+        out["serial_ms"] = serial_s * 1e3
+        out["serial_binds"] = r["binds"]
+        if verbose:
+            print(f"[cfg{cfg}] serial: {out['serial_ms']:.1f} ms "
+                  f"({'extrapolated' if out.get('serial_extrapolated') else 'measured'})",
+                  file=sys.stderr)
+
+    if backend in ("tpu", "both", "auto"):
+        cache, _, tpu_tiers, actions, n_tasks = build_config(cfg, scale)
+        cold = _session_once(cache, tpu_tiers, actions, mesh=mesh)
+        out["tpu_cold_ms"] = cold["actions_s"] * 1e3
+        out["tpu_cold_profile"] = cold["profile"]
+        # warm: fresh identical cluster, compiled program reused
+        cache, _, tpu_tiers, actions, n_tasks = build_config(cfg, scale)
+        warm = _session_once(cache, tpu_tiers, actions, mesh=mesh)
+        out["tpu_ms"] = warm["actions_s"] * 1e3
+        out["tpu_binds"] = warm["binds"]
+        out["tpu_profile"] = warm["profile"]
+        out["tasks"] = n_tasks
+        if verbose:
+            p = warm["profile"]
+            print(f"[cfg{cfg}] tpu warm: {out['tpu_ms']:.1f} ms "
+                  f"(encode {p.get('encode_s', 0)*1e3:.1f} solve {p.get('solve_s', 0)*1e3:.1f} "
+                  f"apply {p.get('apply_s', 0)*1e3:.1f}) binds={warm['binds']}",
+                  file=sys.stderr)
+
+    if "serial_ms" in out and "tpu_ms" in out and out["tpu_ms"] > 0:
+        out["speedup"] = out["serial_ms"] / out["tpu_ms"]
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=5, choices=[1, 2, 3, 4, 5])
+    ap.add_argument("--all", action="store_true", help="run all five configs")
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--backend", choices=["serial", "tpu", "both", "auto"], default="auto")
+    ap.add_argument("--serial-budget", type=float, default=60.0,
+                    help="max seconds to spend measuring the serial loop")
+    ap.add_argument("--mesh", action="store_true",
+                    help="shard the node axis across all local devices")
+    args = ap.parse_args()
+
+    mesh = None
+    if args.mesh:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        if len(devs) > 1:
+            mesh = Mesh(np.array(devs), ("nodes",))
+
+    results = []
+    cfgs = [1, 2, 3, 4, 5] if args.all else [args.config]
+    for cfg in cfgs:
+        results.append(run_config(cfg, args.scale, args.backend,
+                                  args.serial_budget, mesh=mesh))
+
+    headline = results[-1]
+    final = {
+        "metric": "scheduler-session latency (ms) @ %dk tasks x %dk nodes"
+                  % (int(50 * args.scale), int(10 * args.scale))
+                  if headline["config"] == 5 else
+                  f"scheduler-session latency (ms), cfg {headline['config']} ({headline['name']})",
+        "value": round(headline.get("tpu_ms", headline.get("serial_ms", 0.0)), 3),
+        "unit": "ms",
+        "vs_baseline": round(headline.get("speedup", 0.0), 3),
+    }
+    if len(results) > 1:
+        final["all_configs"] = [
+            {k: v for k, v in r.items() if not k.endswith("profile")} for r in results
+        ]
+    print(json.dumps(final))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

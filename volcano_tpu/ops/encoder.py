@@ -270,7 +270,9 @@ def encode_session(ssn) -> EncodedSnapshot:
     task_initreq = np.zeros((t_count, R), np.float64)
     task_nz_cpu = np.zeros(t_count, np.float64)
     task_nz_mem = np.zeros(t_count, np.float64)
+    task_has_pod = np.zeros(t_count, bool)
     for ti, t in enumerate(task_infos):
+        task_has_pod[ti] = t.pod is not None
         task_req[ti] = _resource_vec(t.resreq, rnames)
         task_initreq[ti] = _resource_vec(t.init_resreq, rnames)
         task_nz_cpu[ti] = t.resreq.milli_cpu if t.resreq.milli_cpu != 0 else nodeorder_mod.DEFAULT_MILLI_CPU_REQUEST
@@ -291,7 +293,9 @@ def encode_session(ssn) -> EncodedSnapshot:
         for si, rep in enumerate(sig_rep):
             pod = rep.pod
             if pod is None:
-                sig_mask[si] = node_ok
+                # the predicates plugin early-returns for podless tasks
+                # (predicates.py predicate_fn: pod is None -> pass), so the
+                # static mask must stay all-True for them
                 continue
             row = np.array(
                 [
@@ -430,6 +434,7 @@ def encode_session(ssn) -> EncodedSnapshot:
         task_nz_cpu=task_nz_cpu,
         task_nz_mem=task_nz_mem,
         task_sig=np.array(task_sig, np.int32) if task_sig else np.zeros(0, np.int32),
+        task_has_pod=task_has_pod,
         sig_mask=sig_mask,
         affinity_score=affinity_score,
         node_idle=node_idle.astype(np.float64),

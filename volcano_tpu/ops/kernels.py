@@ -44,7 +44,7 @@ from volcano_tpu.api.resource import (  # noqa: F401 (re-exported for kernels)
     MIN_MILLI_SCALAR,
 )
 
-MAX_PRIORITY = 10.0
+from volcano_tpu.scheduler.plugins.nodeorder import MAX_PRIORITY  # noqa: E402
 
 _BIG_I32 = jnp.iinfo(jnp.int32).max
 
@@ -219,7 +219,9 @@ def _inner_task_loop(spec: SolveSpec, enc, st, j):
         fit = _fits(enc["task_initreq"][t], c["idle"], eps, is_scalar)
         mask = enc["sig_mask"][sig] & fit
         if spec.check_pod_count:
-            mask = mask & (c["cnt"] < enc["node_max_tasks"])
+            # podless tasks skip the whole predicate chain (predicates.py
+            # early-return), including the pod-count check
+            mask = mask & ((c["cnt"] < enc["node_max_tasks"]) | ~enc["task_has_pod"][t])
         sel, processed, found = _sample_window(
             mask, enc["node_real"], enc["real_n"], c["rr"], enc["num_to_find"])
         rr = ((c["rr"] + processed) % enc["real_n"]).astype(jnp.int32)
@@ -339,11 +341,11 @@ def _make_visit(spec: SolveSpec, enc):
 def solve_allocate(spec: SolveSpec, enc: dict, rr0, num_to_find):
     """Run the whole allocate session on device.
 
-    enc: dict of dense arrays from the encoder (see encoder.EncodedSnapshot
-    .device_dict()). Returns (assign [T] int32 node index or -1, rr final).
+    enc: dict of dense arrays (encoder.encode_session -> solver.pad_encoded,
+    cast/sharded by BatchAllocator). Returns (assign [T] int32 node index or
+    -1, final round-robin index).
     """
     T = enc["task_req"].shape[0]
-    N = enc["node_idle"].shape[0]
     enc = dict(enc, num_to_find=num_to_find)
 
     st = dict(

@@ -58,7 +58,8 @@ def pad_encoded(enc: EncodedSnapshot, node_multiple: int = 1) -> Dict[str, np.nd
     t, n, j, q, ns, s = enc.shape
     tb, jb = _bucket(t), _bucket(j)
     a = dict(enc.arrays)
-    for name in ("task_req", "task_initreq", "task_nz_cpu", "task_nz_mem", "task_sig"):
+    for name in ("task_req", "task_initreq", "task_nz_cpu", "task_nz_mem",
+                 "task_sig", "task_has_pod"):
         a[name] = _pad_axis(a[name], 0, tb)
     for name in (
         "job_task_start", "job_task_count", "job_queue", "job_ns",
@@ -89,8 +90,6 @@ class BatchAllocator:
         self.profile = profile if profile is not None else {}
 
     def _cast(self, arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        import jax.numpy as jnp
-
         dtype = self.dtype
         if dtype is None:
             import jax

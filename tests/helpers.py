@@ -1,44 +1,13 @@
 """Shared test scaffolding: build a cache with fakes, open sessions with
-explicit tiers (the allocate_test.go:39-223 harness shape)."""
+explicit tiers (the allocate_test.go:39-223 harness shape).
+
+The builders live in volcano_tpu.bench.clusters so the bench rig and the
+test harness can never diverge; this module re-exports them plus the
+session lifecycle helpers.
+"""
 
 from __future__ import annotations
 
-from volcano_tpu.scheduler import conf
-from volcano_tpu.scheduler.cache import SchedulerCache
+from volcano_tpu.bench.clusters import make_cache, make_tiers  # noqa: F401
 from volcano_tpu.scheduler.framework import open_session, close_session  # noqa: F401
-from volcano_tpu.scheduler.plugins import apply_plugin_conf_defaults
-from volcano_tpu.scheduler.util import scheduler_helper
-from volcano_tpu.scheduler.util.test_utils import (
-    FakeBinder,
-    FakeEvictor,
-    FakeStatusUpdater,
-    FakeVolumeBinder,
-)
 import volcano_tpu.scheduler.actions  # noqa: F401  (register actions)
-
-
-def make_cache(store=None, **kwargs):
-    scheduler_helper.reset_round_robin()
-    return SchedulerCache(
-        store=store,
-        binder=FakeBinder(),
-        evictor=FakeEvictor(),
-        status_updater=FakeStatusUpdater(),
-        volume_binder=FakeVolumeBinder(),
-        **kwargs,
-    )
-
-
-def make_tiers(*tier_plugin_names, arguments=None):
-    """make_tiers(["priority", "gang"], ["drf", "proportion"]) — with all
-    enable flags defaulted True."""
-    arguments = arguments or {}
-    tiers = []
-    for names in tier_plugin_names:
-        options = []
-        for name in names:
-            option = conf.PluginOption(name=name, arguments=arguments.get(name, {}))
-            apply_plugin_conf_defaults(option)
-            options.append(option)
-        tiers.append(conf.Tier(plugins=options))
-    return tiers

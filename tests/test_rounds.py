@@ -1,0 +1,170 @@
+"""Rounds-mode solver invariants.
+
+Rounds mode trades the serial loop's visit-granular ordering for bulk
+placement (ops/rounds.py), so bindings are not bit-identical to the oracle.
+These tests assert what IS guaranteed: feasibility of every placement under
+the epsilon arithmetic and predicate masks, node capacity and pod-count
+limits, gang all-or-nothing atomicity, and placement quality (>= the serial
+loop's bind count on capacity-abundant clusters, since rounds mode sees every
+node where the serial loop samples).
+"""
+
+from __future__ import annotations
+
+import random
+
+from tests.helpers import make_cache, make_tiers
+from tests.test_tpu_parity import DEFAULT_TIERS, gang_cluster
+from volcano_tpu.api import objects
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.scheduler.framework import close_session, get_action, open_session
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list_with_pods,
+)
+
+ROUNDS_ARGS = {"tpuscore": {"tpuscore.mode": "rounds"}}
+
+
+def run_rounds(populate, tiers=DEFAULT_TIERS):
+    cache = make_cache()
+    populate(cache)
+    ssn = open_session(
+        cache, make_tiers(["tpuscore"], *tiers, arguments=ROUNDS_ARGS))
+    get_action("allocate").execute(ssn)
+    prof = dict(ssn.plugins["tpuscore"].profile)
+    assert prof.get("mode") == "rounds", prof
+    assert "fallback" not in prof, prof
+    close_session(ssn)
+    return cache, prof
+
+
+def run_serial(populate, tiers=DEFAULT_TIERS):
+    cache = make_cache()
+    populate(cache)
+    ssn = open_session(cache, make_tiers(*tiers))
+    get_action("allocate").execute(ssn)
+    close_session(ssn)
+    return cache.binder.binds
+
+
+def check_invariants(cache, populate_min_members):
+    """Feasibility + gang atomicity over the FakeBinder result."""
+    binds = cache.binder.binds
+    # rebuild node capacity from the cache's own node infos
+    per_node = {}
+    for key, node_name in binds.items():
+        per_node.setdefault(node_name, []).append(key)
+    for node_name, keys in per_node.items():
+        node = cache.nodes[node_name]
+        total = Resource.empty()
+        for key in keys:
+            ns, name = key.split("/")
+            pg = name.rsplit("-", 1)[0]
+            job = cache.jobs[f"{ns}/{pg}"]
+            task = next(t for t in job.tasks.values() if t.name == name)
+            total.add(task.resreq)
+        assert total.less_equal(node.allocatable), (
+            f"node {node_name} over-allocated: {total} > {node.allocatable}")
+        assert len(keys) <= node.allocatable.max_task_num
+
+    # gang all-or-nothing
+    counts = {}
+    for key in binds:
+        pg = key.split("/")[1].rsplit("-", 1)[0]
+        counts[pg] = counts.get(pg, 0) + 1
+    for pg, n in counts.items():
+        assert n >= populate_min_members, f"gang {pg} bound {n} < min"
+
+
+class TestRounds:
+    def test_gang_atomicity_and_feasibility(self):
+        populate = gang_cluster(n_groups=20, min_member=4, n_nodes=6)
+        cache, prof = run_rounds(populate)
+        check_invariants(cache, 4)
+        assert prof["rounds"] >= 1
+
+    def test_matches_serial_quality_when_abundant(self):
+        # with abundant capacity both backends must place every task
+        populate = gang_cluster(n_groups=10, min_member=4, n_nodes=20)
+        serial = run_serial(populate)
+        cache, _ = run_rounds(populate)
+        assert len(cache.binder.binds) == len(serial) == 40
+
+    def test_quality_at_contention(self):
+        # tight capacity: rounds mode must bind at least as many whole gangs
+        # as the serial loop does (it sees all nodes, never samples)
+        populate = gang_cluster(n_groups=24, min_member=4, n_nodes=5)
+        serial = run_serial(populate)
+        cache, _ = run_rounds(populate)
+        check_invariants(cache, 4)
+        assert len(cache.binder.binds) >= len(serial) * 0.9
+
+    def test_no_capacity_binds_nothing(self):
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            c.add_pod_group(build_pod_group("pg1", namespace="ns1", min_member=3))
+            for i in range(3):
+                c.add_pod(build_pod("ns1", f"pg1-p{i}", "", objects.POD_PHASE_PENDING,
+                                    {"cpu": "4", "memory": "4Gi"}, "pg1"))
+            c.add_node(build_node("n1", build_resource_list_with_pods("4", "8Gi")))
+
+        cache, _ = run_rounds(populate)
+        assert cache.binder.binds == {}
+
+    def test_selectors_respected(self):
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            for g, zone in enumerate(["a", "b", "a", "b"]):
+                pg = f"pg{g}"
+                c.add_pod_group(build_pod_group(pg, namespace="ns1", min_member=2))
+                for i in range(2):
+                    c.add_pod(build_pod("ns1", f"{pg}-p{i}", "",
+                                        objects.POD_PHASE_PENDING,
+                                        {"cpu": "1", "memory": "1Gi"}, pg,
+                                        node_selector={"zone": zone}))
+            for n in range(4):
+                c.add_node(build_node(
+                    f"node-{n:03d}", build_resource_list_with_pods("4", "8Gi"),
+                    labels={"zone": "a" if n < 2 else "b"}))
+
+        cache, _ = run_rounds(populate)
+        assert len(cache.binder.binds) == 8
+        for key, node in cache.binder.binds.items():
+            g = int(key.split("/")[1][2])
+            want = "a" if g % 2 == 0 else "b"
+            n = int(node.split("-")[1])
+            assert (n < 2) == (want == "a"), f"{key} on wrong zone node {node}"
+
+    def test_fair_share_multi_queue(self):
+        # 2 queues, equal weight, demand 2x capacity: each queue should land
+        # roughly half the bindings through the overused gate
+        def populate(c):
+            rng = random.Random(9)
+            c.add_queue(build_queue("q-a", weight=1))
+            c.add_queue(build_queue("q-b", weight=1))
+            for g in range(16):
+                q = "q-a" if g % 2 == 0 else "q-b"
+                pg = f"pg{g:02d}"
+                c.add_pod_group(build_pod_group(pg, namespace="ns1",
+                                                min_member=2, queue=q))
+                for i in range(2):
+                    c.add_pod(build_pod("ns1", f"{pg}-p{i}", "",
+                                        objects.POD_PHASE_PENDING,
+                                        {"cpu": "1", "memory": "1Gi"}, pg))
+            for n in range(4):
+                c.add_node(build_node(
+                    f"node-{n:03d}", build_resource_list_with_pods("4", "8Gi")))
+
+        cache, _ = run_rounds(populate, tiers=(["priority", "gang"],
+                                               ["drf", "proportion"]))
+        by_queue = {"q-a": 0, "q-b": 0}
+        for key in cache.binder.binds:
+            g = int(key.split("/")[1][2:4])
+            by_queue["q-a" if g % 2 == 0 else "q-b"] += 1
+        total = sum(by_queue.values())
+        assert total > 0
+        assert abs(by_queue["q-a"] - by_queue["q-b"]) <= 4, by_queue

@@ -130,20 +130,21 @@ class SolveSpec(NamedTuple):
     max_visits: int
 
 
-def _node_score(spec: SolveSpec, st, enc, t):
-    """Fused per-node score for task t: binpack + nodeorder
-    (binpack.go:201-261, nodeorder.go:161-200). Returns [N] float."""
-    used = st["used"]          # [N, R]
+def fused_scores(spec: SolveSpec, enc, used, req, nz_cpu, nz_mem, sig):
+    """Fused binpack + nodeorder node scores (binpack.go:201-261,
+    nodeorder.go:161-200), broadcast over any leading task dims.
+
+    used/alloc: [N, R]; req: [..., R]; nz_cpu/nz_mem: [...]; sig: [...] int.
+    Returns [..., N] float scores.
+    """
     alloc = enc["node_alloc"]  # [N, R] allocatable
-    req = enc["task_req"][t]   # [R]
-    score = jnp.zeros(used.shape[0], used.dtype)
+    lead = req.shape[:-1]
+    score = jnp.zeros(lead + (used.shape[0],), used.dtype)
 
     if spec.use_nodeorder:
-        nz_cpu = enc["task_nz_cpu"][t]
-        nz_mem = enc["task_nz_mem"][t]
-        cap_cpu, cap_mem = alloc[:, 0], alloc[:, 1]
-        want_cpu = used[:, 0] + nz_cpu
-        want_mem = used[:, 1] + nz_mem
+        cap_cpu, cap_mem = alloc[:, 0], alloc[:, 1]          # [N]
+        want_cpu = used[:, 0] + nz_cpu[..., None]            # [..., N]
+        want_mem = used[:, 1] + nz_mem[..., None]
 
         def dim(cap, want):
             ok = (cap > 0) & (want <= cap)
@@ -161,20 +162,28 @@ def _node_score(spec: SolveSpec, st, enc, t):
         )
         score = score + least * enc["least_req_weight"] + balanced * enc["balanced_weight"]
         # static preferred node-affinity score, per signature
-        score = score + enc["affinity_score"][enc["task_sig"][t]] * enc["node_affinity_weight"]
+        score = score + enc["affinity_score"][sig] * enc["node_affinity_weight"]
 
     if spec.use_binpack:
         # per-dim weights zeroed where the task requests nothing
-        w_eff = jnp.where(req > 0, enc["binpack_w"], 0.0)  # [R]
-        w_sum = jnp.sum(w_eff)
-        want = req[None, :] + used                          # [N, R]
+        w_eff = jnp.where(req > 0, enc["binpack_w"], 0.0)    # [..., R]
+        w_sum = jnp.sum(w_eff, axis=-1)                      # [...]
+        want = req[..., None, :] + used                      # [..., N, R]
         ok = (alloc > 0) & (want <= alloc)
-        part = jnp.where(ok, want * w_eff[None, :] / jnp.where(alloc > 0, alloc, 1.0), 0.0)
-        raw = jnp.sum(part, axis=-1)
-        bp = jnp.where(w_sum > 0, raw / jnp.where(w_sum > 0, w_sum, 1.0), 0.0)
+        part = jnp.where(ok, want * w_eff[..., None, :] / jnp.where(alloc > 0, alloc, 1.0), 0.0)
+        raw = jnp.sum(part, axis=-1)                         # [..., N]
+        bp = jnp.where((w_sum > 0)[..., None], raw / jnp.where(w_sum > 0, w_sum, 1.0)[..., None], 0.0)
         score = score + bp * MAX_PRIORITY * enc["binpack_weight"]
 
     return score
+
+
+def _node_score(spec: SolveSpec, st, enc, t):
+    """[N] scores for one task index (parity-scan path)."""
+    return fused_scores(
+        spec, enc, st["used"], enc["task_req"][t],
+        enc["task_nz_cpu"][t], enc["task_nz_mem"][t], enc["task_sig"][t],
+    )
 
 
 def _job_keys(spec: SolveSpec, st, enc):

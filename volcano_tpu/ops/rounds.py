@@ -1,0 +1,342 @@
+"""Rounds-mode throughput solver: bulk-synchronous batched placement.
+
+The parity scan (kernels.solve_allocate) reproduces the serial loop's
+bindings bit-for-bit but pays one sequential device step per task — latency-
+bound at ~50k steps for the headline config. This module is the TPU-native
+redesign for scale (SURVEY.md §7 "hard parts": solve in *rounds* — batch-
+score all pending tasks, commit gang blocks, re-score deltas on device):
+
+Round (all on device, one jitted while_loop):
+1. job-order keys -> job rank (lexsort over J), task rank = (job rank, task
+   order); tasks in overused queues sit the round out (proportion.go:201).
+2. chunked (T x N) fused feasibility ∧ epsilon-fit ∧ pod-count masks and
+   binpack+nodeorder scores -> per-task best node (argmax, lowest-index
+   tie-break = smallest node name).
+3. conflict resolution: sort tasks by (chosen node, task rank); per-node
+   *prefix acceptance* — the longest priority-prefix whose cumulative request
+   fits idle (cumsum ≤ idle + eps reproduces the serial per-step epsilon
+   exactly) and pod slots.
+4. scatter-commit: idle/used/pod-count, job/queue/namespace allocation.
+Rounds repeat while any task lands. Then a gang-rollback pass retires the
+worst-ranked job still short of min_available (statement.go Discard
+semantics) and rounds resume on the freed capacity — a fixpoint loop that
+terminates because each rollback retires exactly one job.
+
+Documented divergences from the serial oracle (and hence from parity mode):
+scores are computed against round-start state (bulk-synchronous), fair-share
+interleaving is round- rather than visit-grained, overused queues re-enter
+when a rollback drops them below deserved, and the adaptive node-sampling
+window does not apply (every task sees every node — strictly better
+placements than the reference's sampled serial loop).
+
+Invariants preserved (asserted by tests/test_rounds.py): every placement is
+feasible per the predicate mask and epsilon arithmetic, no node exceeds idle
+or pod capacity, gangs are all-or-nothing, queue `deserved` caps are
+respected through the overused gate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from volcano_tpu.ops.kernels import (
+    MIN_MILLI_SCALAR,
+    SolveSpec,
+    _share,
+    fused_scores,
+)
+
+CHUNK = 1024
+
+
+def _job_rank(spec: SolveSpec, enc, job_placed, job_alloc):
+    """[J] dense rank from the tiered job-order keys (low = first)."""
+    keys = [enc["job_tie_rank"]]
+    for name in reversed(spec.job_order_keys):
+        if name == "priority":
+            keys.append(-enc["job_priority"])
+        elif name == "gang":
+            ready = (enc["job_ready_base"] + job_placed) >= enc["job_min_available"]
+            keys.append(ready.astype(jnp.int32))
+        elif name == "drf":
+            keys.append(_share(job_alloc, enc["drf_total"][None, :],
+                               enc["drf_present"][None, :]))
+    order = jnp.lexsort(tuple(keys))  # last key primary
+    j = enc["job_tie_rank"].shape[0]
+    return jnp.zeros(j, jnp.int32).at[order].set(jnp.arange(j, dtype=jnp.int32))
+
+
+def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
+    """Per-task best feasible node: chunked masked argmax.
+
+    Returns (choice [T] int32, -1 when nothing feasible)."""
+    t_total = enc["task_req"].shape[0]
+    chunk = min(CHUNK, t_total)  # both are powers of two (solver buckets)
+    n_chunks = t_total // chunk
+    eps = enc["eps"]
+    is_scalar = enc["is_scalar"]
+    neg = jnp.array(-jnp.inf, idle.dtype)
+
+    def one_chunk(ci):
+        sl = ci * chunk
+        req = lax.dynamic_slice_in_dim(enc["task_req"], sl, chunk)
+        initreq = lax.dynamic_slice_in_dim(enc["task_initreq"], sl, chunk)
+        sig = lax.dynamic_slice_in_dim(enc["task_sig"], sl, chunk)
+        nz_cpu = lax.dynamic_slice_in_dim(enc["task_nz_cpu"], sl, chunk)
+        nz_mem = lax.dynamic_slice_in_dim(enc["task_nz_mem"], sl, chunk)
+        has_pod = lax.dynamic_slice_in_dim(enc["task_has_pod"], sl, chunk)
+        act = lax.dynamic_slice_in_dim(active, sl, chunk)
+
+        # epsilon fit of init requests against idle (resource_info.go:267)
+        le = initreq[:, None, :] < idle[None, :, :] + eps[None, None, :]
+        skip = is_scalar[None, None, :] & (initreq[:, None, :] <= MIN_MILLI_SCALAR)
+        fit = jnp.all(le | skip, axis=-1)                     # [C, N]
+        mask = fit & enc["sig_mask"][sig]
+        if spec.check_pod_count:
+            mask = mask & ((cnt[None, :] < enc["node_max_tasks"][None, :])
+                           | ~has_pod[:, None])
+        mask = mask & act[:, None]
+
+        score = fused_scores(spec, enc, used, req, nz_cpu, nz_mem, sig)
+        masked = jnp.where(mask, score, neg)
+        # deterministic tie spreading: scores are coarse (floor-based), so
+        # whole gangs tie on one node and would fill the cluster one node
+        # per round; among the tied best nodes, task t takes the
+        # (t mod n_tied)-th — exact-tie-only, score order is untouched
+        # (divergence from the serial min-name tie-break, see module doc)
+        m = jnp.max(masked, axis=-1, keepdims=True)
+        tied = (masked == m) & mask                       # [C, N]
+        n_tied = jnp.sum(tied, axis=-1)                   # [C]
+        t_idx = sl + jnp.arange(chunk)
+        kth = (t_idx % jnp.maximum(n_tied, 1)).astype(jnp.int32)
+        csum = jnp.cumsum(tied.astype(jnp.int32), axis=-1)
+        best = jnp.argmax(tied & (csum == (kth + 1)[:, None]), axis=-1).astype(jnp.int32)
+        feasible = jnp.any(mask, axis=-1)
+        return jnp.where(feasible, best, -1)
+
+    chunks = lax.map(one_chunk, jnp.arange(n_chunks))
+    return chunks.reshape(t_total)
+
+
+def _resolve(spec: SolveSpec, enc, idle, cnt, choice, task_rank):
+    """Per-node prefix acceptance: sort by (node, rank), accept the longest
+    priority-prefix whose cumulative request fits. Returns accept [T] bool."""
+    t_total = choice.shape[0]
+    eps = enc["eps"]
+    has_pod = enc["task_has_pod"]
+    # conservative integer units (milli-cpu / MiB / milli-scalar): a float32
+    # running cumsum over 50k tasks drifts past the 10 MiB memory epsilon at
+    # ~1e14-byte magnitudes; int32 in these units is exact (headline totals
+    # ~1e8 << 2^31) and the ceil(req)/floor(idle) pairing can only
+    # under-place by <1 unit, never over-allocate
+    req_i = jnp.ceil(enc["task_req"] / enc["res_unit"][None, :]).astype(jnp.int32)
+    idle_i = jnp.floor(idle / enc["res_unit"][None, :]).astype(jnp.int32)
+    eps_i = (enc["eps"] / enc["res_unit"]).astype(jnp.int32)
+    is_scalar = enc["is_scalar"]
+
+    feas = choice >= 0
+    # infeasible tasks sort to a trailing pseudo-node segment
+    node_key = jnp.where(feas, choice, jnp.iinfo(jnp.int32).max)
+    order = jnp.lexsort((task_rank, node_key))                # node primary
+    ch_s = node_key[order]
+    req_s = req_i[order]
+    pod_s = has_pod[order] & (ch_s != jnp.iinfo(jnp.int32).max)
+
+    seg_start = jnp.concatenate([jnp.ones(1, bool), ch_s[1:] != ch_s[:-1]])
+    idx = jnp.arange(t_total)
+    start_idx = lax.cummax(jnp.where(seg_start, idx, 0))
+    c = jnp.cumsum(req_s, axis=0)                             # exact int32
+    base = jnp.where(start_idx[:, None] > 0, c[jnp.maximum(start_idx - 1, 0)], 0)
+    seg_cum = c - base                                        # [T, R] incl. self
+
+    node = jnp.clip(ch_s, 0, idle.shape[0] - 1)
+    idle_s = idle_i[node]                                     # [T, R]
+    # stepwise-epsilon equivalence: task k fits iff cumsum_k <= idle + eps
+    le = seg_cum < idle_s + eps_i[None, :]
+    skip = is_scalar[None, :] & (req_s <= MIN_MILLI_SCALAR)
+    fits = jnp.all(le | skip, axis=-1) & (ch_s != jnp.iinfo(jnp.int32).max)
+
+    cond = fits
+    if spec.check_pod_count:
+        # the pod-count cap is part of the predicates plugin; without it the
+        # serial loop never checks len(node.tasks) (predicates.py:191)
+        pod_rank = jnp.cumsum(pod_s.astype(jnp.int32))
+        pod_base = jnp.where(start_idx > 0, pod_rank[jnp.maximum(start_idx - 1, 0)], 0)
+        seg_pods = pod_rank - pod_base
+        pods_ok = ~pod_s | (cnt[node] + seg_pods <= enc["node_max_tasks"][node])
+        cond = fits & pods_ok
+
+    # longest true-prefix per segment: no rejections before me in my segment
+    rej = jnp.cumsum((~cond).astype(jnp.int32))
+    rej_base = jnp.where(start_idx > 0, rej[jnp.maximum(start_idx - 1, 0)], 0)
+    accept_s = cond & ((rej - rej_base - (~cond).astype(jnp.int32)) == 0)
+
+    return jnp.zeros(t_total, bool).at[order].set(accept_s)
+
+
+def _queue_budget(enc, queue_alloc, accept, task_rank, task_queue, task_job):
+    """Job-granular queue fair-share cap inside a round.
+
+    The serial loop checks Overused between job visits: a job is admitted
+    while its queue's allocated <= deserved at the START of the job's turn,
+    so queues overshoot deserved by at most one job block
+    (proportion.go:201-212 + allocate.go:134-146). Reproduce that here: for
+    accepted tasks ordered (queue, rank), a job's tasks survive iff
+    queue_alloc + contributions of higher-ranked jobs in the same queue
+    fit under deserved with the epsilon comparison.
+    """
+    t_total = accept.shape[0]
+    is_scalar = enc["is_scalar"]
+    # same exact-int32 units as _resolve (see the drift note there)
+    unit = enc["res_unit"]
+    eps_i = (enc["eps"] / unit).astype(jnp.int32)
+    req_i = jnp.ceil(enc["task_req"] / unit[None, :]).astype(jnp.int32)
+    req = jnp.where(accept[:, None], req_i, 0)
+
+    order = jnp.lexsort((task_rank, task_queue))  # queue primary
+    req_s = req[order]
+    q_s = task_queue[order]
+    job_s = task_job[order]
+
+    idx = jnp.arange(t_total)
+    q_start = jnp.concatenate([jnp.ones(1, bool), q_s[1:] != q_s[:-1]])
+    j_start = q_start | jnp.concatenate([jnp.ones(1, bool), job_s[1:] != job_s[:-1]])
+
+    c = jnp.cumsum(req_s, axis=0)                   # exact int32
+    q_base_idx = lax.cummax(jnp.where(q_start, idx, 0))
+    j_base_idx = lax.cummax(jnp.where(j_start, idx, 0))
+    q_base = jnp.where(q_base_idx[:, None] > 0, c[jnp.maximum(q_base_idx - 1, 0)], 0)
+    j_base = jnp.where(j_base_idx[:, None] > 0, c[jnp.maximum(j_base_idx - 1, 0)], 0)
+    queue_cum_before_job = j_base - q_base          # higher-ranked jobs, same queue
+
+    alloc_i = jnp.ceil(queue_alloc / unit[None, :]).astype(jnp.int32)
+    deserved_i = jnp.floor(enc["queue_deserved"] / unit[None, :]).astype(jnp.int32)
+    alloc_before = alloc_i[q_s] + queue_cum_before_job
+    le = alloc_before < deserved_i[q_s] + eps_i[None, :]
+    skip = is_scalar[None, :] & (alloc_before <= MIN_MILLI_SCALAR)
+    ok = jnp.all(le | skip, axis=-1)
+
+    accept_s = accept[order] & ok
+    return jnp.zeros(t_total, bool).at[order].set(accept_s)
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def solve_rounds(spec: SolveSpec, enc: dict):
+    """Batched allocate session. Returns (assign [T] int32 node or -1,
+    rounds used)."""
+    t_total = enc["task_req"].shape[0]
+    j_total = enc["job_tie_rank"].shape[0]
+    dt = enc["task_req"].dtype
+
+    task_job = enc["task_job"]
+    task_queue = enc["job_queue"][task_job]
+    task_ns = enc["job_ns"][task_job]
+    task_in_job = (jnp.arange(t_total, dtype=jnp.int32)
+                   - enc["job_task_start"][task_job])
+    # valid flat tasks (padding carries job index 0 but count excludes them)
+    task_valid = (jnp.arange(t_total, dtype=jnp.int32)
+                  < (enc["job_task_start"][task_job] + enc["job_task_count"][task_job])) \
+        & enc["job_active0"][task_job]
+
+    max_tasks_per_job = jnp.int32(t_total)
+
+    st = dict(
+        idle=enc["node_idle"], used=enc["node_used"],
+        cnt=enc["node_cnt"],
+        assign=jnp.full((t_total,), -1, jnp.int32),
+        active=task_valid,
+        job_placed=jnp.zeros(j_total, jnp.int32),
+        job_alloc=enc["job_alloc0"],
+        queue_alloc=enc["queue_alloc0"],
+        ns_alloc=enc["ns_alloc0"],
+        rounds=jnp.int32(0),
+        progress=jnp.bool_(True),
+        dead=jnp.bool_(False),  # outer fixpoint reached
+    )
+
+    def round_body(st):
+        job_rank = _job_rank(spec, enc, st["job_placed"], st["job_alloc"])
+        task_rank = job_rank[task_job] * max_tasks_per_job + task_in_job
+
+        active = st["active"]
+        if spec.use_prop_overused:
+            over = ~_le_eps_rows(st["queue_alloc"], enc["queue_deserved"],
+                                 enc["eps"], enc["is_scalar"])
+            active = active & ~over[task_queue]
+
+        choice = _choices(spec, enc, st["idle"], st["used"], st["cnt"], active)
+        accept = _resolve(spec, enc, st["idle"], st["cnt"], choice, task_rank)
+        if spec.use_prop_overused:
+            accept = _queue_budget(enc, st["queue_alloc"], accept,
+                                   task_rank, task_queue, task_job)
+
+        node = jnp.clip(choice, 0, st["idle"].shape[0] - 1)
+        dreq = jnp.where(accept[:, None], enc["task_req"], 0.0).astype(dt)
+        idle = st["idle"].at[node].add(-dreq)
+        used = st["used"].at[node].add(dreq)
+        cnt = st["cnt"].at[node].add(accept.astype(jnp.int32))
+        assign = jnp.where(accept, choice, st["assign"])
+        return dict(
+            st,
+            idle=idle, used=used, cnt=cnt, assign=assign,
+            active=st["active"] & ~accept,
+            job_placed=st["job_placed"].at[task_job].add(accept.astype(jnp.int32)),
+            job_alloc=st["job_alloc"].at[task_job].add(dreq),
+            queue_alloc=st["queue_alloc"].at[task_queue].add(dreq),
+            ns_alloc=st["ns_alloc"].at[task_ns].add(dreq),
+            rounds=st["rounds"] + 1,
+            progress=jnp.any(accept),
+        )
+
+    def rollback(st):
+        """Retire the WORST-ranked gang still short of min_available
+        (Statement.Discard semantics). One job per fixpoint iteration, like
+        the serial loop discarding exactly the gang whose turn failed —
+        everything it held frees up for the remaining gangs to retry."""
+        short = (enc["job_ready_base"] + st["job_placed"]) < enc["job_ready_threshold"]
+        cand = short & (st["job_placed"] > 0)
+        job_rank = _job_rank(spec, enc, st["job_placed"], st["job_alloc"])
+        worst = jnp.argmax(jnp.where(cand, job_rank, -1))
+        roll_job = cand & (jnp.arange(j_total) == worst)
+        roll = roll_job[task_job] & (st["assign"] >= 0)
+        node = jnp.clip(st["assign"], 0, st["idle"].shape[0] - 1)
+        dreq = jnp.where(roll[:, None], enc["task_req"], 0.0).astype(dt)
+        dead_task = roll_job[task_job]  # the job leaves the session's queue
+        return dict(
+            st,
+            idle=st["idle"].at[node].add(dreq),
+            used=st["used"].at[node].add(-dreq),
+            cnt=st["cnt"].at[node].add(-roll.astype(jnp.int32)),
+            assign=jnp.where(roll, -1, st["assign"]),
+            active=st["active"] & ~dead_task,
+            job_placed=jnp.where(roll_job, 0, st["job_placed"]),
+            job_alloc=st["job_alloc"].at[task_job].add(-dreq),
+            queue_alloc=st["queue_alloc"].at[task_queue].add(-dreq),
+            ns_alloc=st["ns_alloc"].at[task_ns].add(-dreq),
+            progress=jnp.bool_(True),
+            dead=~jnp.any(cand),
+        ), jnp.any(cand)
+
+    def outer_cond(st):
+        return ~st["dead"] & (st["rounds"] < t_total + j_total + 8)
+
+    def outer_body(st):
+        st = lax.while_loop(
+            lambda s: s["progress"] & (s["rounds"] < t_total + j_total + 8),
+            round_body, st)
+        st, _rolled = rollback(st)
+        return st
+
+    st = lax.while_loop(outer_cond, outer_body, st)
+    return st["assign"], st["rounds"]
+
+
+def _le_eps_rows(l, r, eps, is_scalar):
+    """Rowwise Resource.less_equal for [Q, R] pairs."""
+    le = l < r + eps[None, :]
+    skip = is_scalar[None, :] & (l <= MIN_MILLI_SCALAR)
+    return jnp.all(le | skip, axis=-1)

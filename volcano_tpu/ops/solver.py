@@ -59,7 +59,7 @@ def pad_encoded(enc: EncodedSnapshot, node_multiple: int = 1) -> Dict[str, np.nd
     tb, jb = _bucket(t), _bucket(j)
     a = dict(enc.arrays)
     for name in ("task_req", "task_initreq", "task_nz_cpu", "task_nz_mem",
-                 "task_sig", "task_has_pod"):
+                 "task_sig", "task_has_pod", "task_job"):
         a[name] = _pad_axis(a[name], 0, tb)
     for name in (
         "job_task_start", "job_task_count", "job_queue", "job_ns",
@@ -82,11 +82,22 @@ class BatchAllocator:
 
     Returns True when the batched solve ran; False => the caller must run
     the serial loop (EncoderFallback or no work to do).
+
+    mode:
+      - "parity": the sequential-scan kernel, bit-identical bindings to the
+        serial loop (one device step per task — latency grows with T);
+      - "rounds": the bulk-synchronous throughput kernel (ops/rounds.py),
+        gang/feasibility/fair-share preserving but round-granular ordering;
+      - "auto" (default): rounds when tasks >= auto_rounds_threshold.
     """
 
-    def __init__(self, mesh=None, dtype=None, profile: Optional[dict] = None):
+    AUTO_ROUNDS_THRESHOLD = 2048
+
+    def __init__(self, mesh=None, dtype=None, profile: Optional[dict] = None,
+                 mode: str = "auto"):
         self.mesh = mesh
         self.dtype = dtype
+        self.mode = mode
         self.profile = profile if profile is not None else {}
 
     def _cast(self, arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
@@ -133,6 +144,10 @@ class BatchAllocator:
             # nothing to place; serial loop is also a no-op but cheaper
             return False
 
+        mode = self.mode
+        if mode == "auto":
+            mode = "rounds" if t >= self.AUTO_ROUNDS_THRESHOLD else "parity"
+
         try:
             node_multiple = 1
             if self.mesh is not None:
@@ -142,22 +157,31 @@ class BatchAllocator:
                 arrays = self._shard(arrays)
             t1 = time.perf_counter()
 
-            assign, rr = kernels.solve_allocate(
-                enc.spec, arrays, np.int32(enc.rr0), np.int32(enc.num_to_find)
-            )
-            assign = np.asarray(assign)
-            rr = int(rr)
+            if mode == "rounds":
+                from volcano_tpu.ops import rounds as rounds_mod
+
+                assign, n_rounds = rounds_mod.solve_rounds(enc.spec, arrays)
+                assign = np.asarray(assign)
+                self.profile["rounds"] = int(n_rounds)
+            else:
+                assign, rr = kernels.solve_allocate(
+                    enc.spec, arrays, np.int32(enc.rr0), np.int32(enc.num_to_find)
+                )
+                assign = np.asarray(assign)
+                # round-robin index continues across sessions exactly like
+                # the serial helper (scheduler_helper.go:38)
+                scheduler_helper._last_processed_node_index = int(rr)
         except Exception as e:  # any device/compile failure -> serial oracle
             logger.exception("tpuscore solve failed; falling back to serial")
             self.profile["fallback"] = f"solve error: {e}"
             return False
         t2 = time.perf_counter()
+        self.profile["mode"] = mode
 
-        # round-robin index continues across sessions exactly like the serial
-        # helper (scheduler_helper.go:38)
-        scheduler_helper._last_processed_node_index = rr
-
-        self._apply(ssn, enc, assign)
+        if mode == "rounds":
+            self._apply_bulk(ssn, enc, assign)
+        else:
+            self._apply(ssn, enc, assign)
         t3 = time.perf_counter()
         self.profile.update(
             encode_s=t1 - t0, solve_s=t2 - t1, apply_s=t3 - t2,
@@ -210,3 +234,149 @@ class BatchAllocator:
                 stmt.commit()
             else:  # pragma: no cover - device decisions are gang-consistent
                 stmt.discard()
+
+    def _apply_bulk(self, ssn, enc: EncodedSnapshot, assign: np.ndarray) -> None:
+        """Bulk writeback for rounds mode: same end state as the statement
+        path (session + cache task/node/job status, binder calls, plugin
+        shares) but with node and plugin resource accounting vectorized —
+        per-task work is reduced to the status moves and binder call.
+
+        The statement path costs ~40us/task in event handlers, epsilon
+        asserts, and per-task Resource arithmetic; at 50k tasks that is the
+        session bottleneck, not the device solve."""
+        from volcano_tpu.api.resource import Resource
+        from volcano_tpu.api.types import TaskStatus
+        from volcano_tpu.api.unschedule_info import FitErrors
+
+        a = enc.arrays
+        t_real = len(enc.task_infos)
+        assign = assign[:t_real]
+        placed_mask = assign >= 0
+
+        # --- per-node resource deltas via segment sums --------------------
+        node_ids = assign[placed_mask]
+        reqs = a["task_req"][:t_real][placed_mask]
+        n_count = len(enc.node_names)
+        sums = np.zeros((n_count, reqs.shape[1]))
+        np.add.at(sums, node_ids, reqs)
+        counts = np.bincount(node_ids, minlength=n_count)
+
+        # resource dim names recovered from the encoder's layout
+        scalar_names = enc.resource_names[2:]
+
+        def apply_delta(res: Resource, vec, sign: float) -> None:
+            res.milli_cpu += sign * float(vec[0])
+            res.memory += sign * float(vec[1])
+            for si, name in enumerate(scalar_names):
+                q = float(vec[2 + si])
+                if q:
+                    res.add_scalar(name, sign * q)
+
+        placed_idx = np.nonzero(placed_mask)[0]
+        by_job: Dict[int, list] = {}
+        for ti in placed_idx:
+            by_job.setdefault(int(a["task_job"][ti]), []).append(int(ti))
+
+        cache = ssn.cache
+        bind_batch = []
+        for ji, tis in by_job.items():
+            job = enc.job_infos[ji]
+            cache_job = cache.jobs.get(job.uid)
+            for ti in tis:
+                task = enc.task_infos[ti]
+                host = enc.node_names[int(assign[ti])]
+                task.node_name = host
+                job.update_task_status(task, TaskStatus.BINDING)
+                # one BINDING-status clone shared by the session and cache
+                # node maps — both trees only read it for accounting and
+                # predicate checks, and it is never status-flipped in place
+                clone = task.clone()
+                ssn.nodes[host].tasks[_task_key(task)] = clone
+                if cache_job is not None:
+                    ctask = cache_job.tasks.get(task.uid)
+                    if ctask is not None:
+                        ctask.node_name = host
+                        cache_job.update_task_status(ctask, TaskStatus.BINDING)
+                        cnode = cache.nodes.get(host)
+                        if cnode is not None:
+                            cnode.tasks[_task_key(ctask)] = clone
+                # effector contract matches session.dispatch -> cache.bind
+                # (cache.py:372-393): volumes first, then the binder
+                cache.allocate_volumes(task, host)
+                cache.bind_volumes(task)
+                bind_batch.append((task, host))
+        binder = cache.binder
+        try:
+            if hasattr(binder, "bind_many"):
+                binder.bind_many([(t.pod, h) for t, h in bind_batch])
+            else:
+                for task, host in bind_batch:
+                    binder.bind(task.pod, host)
+        except Exception:
+            # per-task retry so one bad pod degrades to resync, not a lost
+            # session (cache.go:597-599 semantics)
+            for task, host in bind_batch:
+                try:
+                    binder.bind(task.pod, host)
+                except Exception:
+                    cache.resync_task(task)
+        if cache.store is not None:
+            for task, host in bind_batch:
+                cache.store.record_event(
+                    task.pod, "Normal", "Scheduled",
+                    f"Successfully assigned "
+                    f"{task.namespace}/{task.name} to {host}",
+                )
+
+        # --- bulk node accounting (session + cache trees) -----------------
+        for ni, name in enumerate(enc.node_names):
+            if not counts[ni]:
+                continue
+            for node in (ssn.nodes.get(name), cache.nodes.get(name)):
+                if node is None:
+                    continue
+                apply_delta(node.idle, sums[ni], -1.0)
+                apply_delta(node.used, sums[ni], +1.0)
+
+        # --- bulk plugin share updates (drf / proportion) -----------------
+        job_sums = np.zeros((len(enc.job_infos), reqs.shape[1]))
+        np.add.at(job_sums, a["task_job"][:t_real][placed_mask], reqs)
+        drf = ssn.plugins.get("drf")
+        prop = ssn.plugins.get("proportion")
+        for ji, job in enumerate(enc.job_infos):
+            if not job_sums[ji].any():
+                continue
+            if drf is not None:
+                attr = drf.job_attrs.get(job.uid)
+                if attr is not None:
+                    apply_delta(attr.allocated, job_sums[ji], +1.0)
+                    drf._update_share(attr)
+                    ns_opt = drf.namespace_opts.get(job.namespace)
+                    if ns_opt is not None:
+                        apply_delta(ns_opt.allocated, job_sums[ji], +1.0)
+                        drf._update_share(ns_opt)
+            if prop is not None:
+                attr = prop.queue_opts.get(job.queue)
+                if attr is not None:
+                    apply_delta(attr.allocated, job_sums[ji], +1.0)
+                    prop._update_share(attr)
+
+        # --- fit errors for gangs the solve could not complete ------------
+        start, count = a["job_task_start"], a["job_task_count"]
+        for ji, job in enumerate(enc.job_infos):
+            lo, hi = int(start[ji]), int(start[ji]) + int(count[ji])
+            if lo == hi:
+                continue
+            unplaced = [ti for ti in range(lo, hi) if assign[ti] < 0]
+            if unplaced and not job.ready():
+                fe = FitErrors()
+                fe.set_error(
+                    "0/%d nodes are available in the batched "
+                    "feasibility/fit solve" % n_count)
+                job.nodes_fit_errors[enc.task_infos[unplaced[0]].uid] = fe
+
+
+def _task_key(task) -> str:
+    from volcano_tpu.api.pod_helpers import pod_key
+
+    return pod_key(task.pod) if task.pod is not None else f"{task.namespace}/{task.name}"

@@ -13,6 +13,9 @@ Arguments:
     tpuscore.dtype:  "float32"/"float64" (default: float64 under jax x64,
                      float32 otherwise; bf16 is rejected — memory-byte
                      epsilons need >8 mantissa bits)
+    tpuscore.mode:   "parity"/"rounds"/"auto" (default auto — rounds for
+                     large sessions, parity-scan for small; see
+                     ops/solver.py BatchAllocator)
 """
 
 from __future__ import annotations
@@ -29,6 +32,7 @@ PLUGIN_NAME = "tpuscore"
 
 ENABLE = "tpuscore.enable"
 DTYPE = "tpuscore.dtype"
+MODE = "tpuscore.mode"
 
 _DTYPES = {"float32": np.float32, "float64": np.float64}
 
@@ -57,8 +61,15 @@ class TpuScorePlugin(Plugin):
                 "tpuscore.dtype %r not supported (%s); using platform default",
                 requested, "/".join(_DTYPES),
             )
+        mode = str(args.get(MODE, "auto")) or "auto"
+        if mode not in ("auto", "parity", "rounds"):
+            logger.warning(
+                "tpuscore.mode %r not supported (auto/parity/rounds); using auto",
+                mode,
+            )
+            mode = "auto"
         ssn.batch_allocator = BatchAllocator(
-            mesh=self.mesh, dtype=dtype, profile=self.profile
+            mesh=self.mesh, dtype=dtype, profile=self.profile, mode=mode
         )
 
     def on_session_close(self, ssn) -> None:

@@ -124,6 +124,15 @@ class FakeBinder:
             self.channel.append(key)
             self._cond.notify_all()
 
+    def bind_many(self, pairs) -> None:
+        """Batch bind under one lock acquisition (bulk-apply fast path)."""
+        with self._cond:
+            for pod, hostname in pairs:
+                key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+                self.binds[key] = hostname
+                self.channel.append(key)
+            self._cond.notify_all()
+
     def wait_for_binds(self, n: int, timeout: float = 5.0) -> bool:
         with self._cond:
             return self._cond.wait_for(lambda: len(self.binds) >= n, timeout)

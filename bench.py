@@ -32,12 +32,12 @@ def _session_once(cache, tiers, actions, mesh=None):
     import volcano_tpu.scheduler.actions  # noqa: F401 (register actions)
     from volcano_tpu.scheduler.framework import close_session, get_action, open_session
 
+    if mesh is not None:
+        from volcano_tpu.scheduler.plugins import tpuscore
+
+        tpuscore.set_default_mesh(mesh)
     t0 = time.perf_counter()
     ssn = open_session(cache, tiers)
-    if mesh is not None and "tpuscore" in ssn.plugins:
-        ssn.plugins["tpuscore"].mesh = mesh
-        if getattr(ssn, "batch_allocator", None) is not None:
-            ssn.batch_allocator.mesh = mesh
     t_open = time.perf_counter()
     for name in actions:
         get_action(name).execute(ssn)

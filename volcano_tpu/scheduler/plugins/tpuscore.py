@@ -36,12 +36,22 @@ MODE = "tpuscore.mode"
 
 _DTYPES = {"float32": np.float32, "float64": np.float64}
 
+# driver-installed default mesh: the scheduler driver calls set_default_mesh
+# once at startup so every session's plugin instance (rebuilt each cycle by
+# open_session) shards over it without post-open patching
+_DEFAULT_MESH = None
+
+
+def set_default_mesh(mesh) -> None:
+    global _DEFAULT_MESH
+    _DEFAULT_MESH = mesh
+
 
 class TpuScorePlugin(Plugin):
     def __init__(self, arguments=None):
         self.arguments = arguments or {}
         self.profile: dict = {}
-        self.mesh = None  # settable by the scheduler driver for multi-chip
+        self.mesh = _DEFAULT_MESH  # per-instance override allowed
 
     def name(self) -> str:
         return PLUGIN_NAME

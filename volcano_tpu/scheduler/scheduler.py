@@ -1,0 +1,205 @@
+"""Scheduler driver — the periodic session loop
+(volcano pkg/scheduler/scheduler.go + util.go).
+
+Every cycle: reload the policy YAML (hot-reload semantics, scheduler.go:77),
+open a session over the cache snapshot, run the configured actions in order,
+close the session (status writeback). The conf schema matches
+conf/scheduler_conf.go:19-58; the default conf is the reference's
+(util.go:31-42) — the tpuscore gate is added via conf, not hardcoded.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import yaml
+
+from volcano_tpu.scheduler import conf, metrics
+from volcano_tpu.scheduler import plugins as _plugins  # noqa: F401 (register)
+from volcano_tpu.scheduler import actions as _actions  # noqa: F401 (register)
+from volcano_tpu.scheduler.framework import (
+    close_session,
+    get_action,
+    open_session,
+)
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_SCHEDULER_CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+# The TPU-gated variant: identical policy tiers plus the tpuscore batch gate.
+TPU_SCHEDULER_CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: tpuscore
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+_FLAG_KEYS = {
+    "enableJobOrder": "enabled_job_order",
+    "enableNamespaceOrder": "enabled_namespace_order",
+    "enableJobReady": "enabled_job_ready",
+    "enableJobPipelined": "enabled_job_pipelined",
+    "enableTaskOrder": "enabled_task_order",
+    "enablePreemptable": "enabled_preemptable",
+    "enableReclaimable": "enabled_reclaimable",
+    "enableQueueOrder": "enabled_queue_order",
+    "enablePredicate": "enabled_predicate",
+    "enableNodeOrder": "enabled_node_order",
+}
+
+
+def _parse_bool(v) -> bool:
+    """Quoted YAML booleans ('false') must not read as truthy strings."""
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("1", "t", "true", "yes")
+
+
+def load_scheduler_conf(conf_str: str) -> Tuple[List, List[conf.Tier]]:
+    """YAML -> ([Action], [Tier]) with per-plugin flag defaulting
+    (util.go:44-72)."""
+    data = yaml.safe_load(conf_str) or {}
+    tiers: List[conf.Tier] = []
+    for tier_data in data.get("tiers", []) or []:
+        options = []
+        for p in tier_data.get("plugins", []) or []:
+            option = conf.PluginOption(name=p["name"])
+            for yaml_key, attr in _FLAG_KEYS.items():
+                if yaml_key in p:
+                    setattr(option, attr, _parse_bool(p[yaml_key]))
+            args = p.get("arguments") or {}
+            option.arguments = {str(k): str(v) for k, v in args.items()}
+            conf.apply_plugin_conf_defaults(option)
+            options.append(option)
+        tiers.append(conf.Tier(plugins=options))
+
+    actions = []
+    for name in str(data.get("actions", "")).split(","):
+        name = name.strip()
+        if not name:
+            continue
+        actions.append(get_action(name))  # raises KeyError like util.go errors
+    return actions, tiers
+
+
+def read_scheduler_conf(path: str) -> str:
+    with open(path) as f:
+        return f.read()
+
+
+class Scheduler:
+    """Periodic scheduler (scheduler.go:34-106)."""
+
+    def __init__(
+        self,
+        cache,
+        scheduler_conf: str = "",
+        schedule_period: float = 1.0,
+        conf_path: Optional[str] = None,
+        mesh=None,
+    ):
+        self.cache = cache
+        self.scheduler_conf = scheduler_conf or DEFAULT_SCHEDULER_CONF
+        self.conf_path = conf_path
+        self.schedule_period = schedule_period
+        if mesh is not None:
+            from volcano_tpu.scheduler.plugins import tpuscore
+
+            tpuscore.set_default_mesh(mesh)
+        self.actions: List = []
+        self.tiers: List[conf.Tier] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self) -> None:
+        """Start cache sync then the periodic loop in a background thread
+        (scheduler.go:63-69)."""
+        self.cache.run()
+        self.cache.wait_for_cache_sync()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if hasattr(self.cache, "stop"):
+            self.cache.stop()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            start = time.perf_counter()
+            try:
+                self.run_once()
+            except Exception:
+                logger.exception("scheduling cycle failed")
+            elapsed = time.perf_counter() - start
+            self._stop.wait(max(self.schedule_period - elapsed, 0.0))
+
+    # -- one cycle ---------------------------------------------------------
+
+    def load_conf(self) -> None:
+        """Hot-reload the policy conf every cycle (scheduler.go:89-106).
+        A transiently unreadable file falls back to the configured conf; a
+        conf that fails to PARSE keeps the last good actions/tiers so a
+        config typo degrades to a logged warning, not a scheduling outage."""
+        conf_str = self.scheduler_conf
+        if self.conf_path:
+            try:
+                conf_str = read_scheduler_conf(self.conf_path)
+            except OSError as e:
+                logger.error(
+                    "failed to read scheduler conf %s, using configured "
+                    "default: %s", self.conf_path, e)
+        try:
+            self.actions, self.tiers = load_scheduler_conf(conf_str)
+        except Exception as e:
+            if self.actions:
+                logger.error(
+                    "invalid scheduler conf, keeping previous policy: %s", e)
+            else:
+                logger.error(
+                    "invalid scheduler conf and no previous policy; "
+                    "using default: %s", e)
+                self.actions, self.tiers = load_scheduler_conf(
+                    DEFAULT_SCHEDULER_CONF)
+
+    def run_once(self) -> None:
+        start = time.perf_counter()
+        self.load_conf()
+
+        ssn = open_session(self.cache, self.tiers)
+        try:
+            for action in self.actions:
+                t0 = time.perf_counter()
+                action.execute(ssn)
+                metrics.update_action_duration(
+                    action.name(), time.perf_counter() - t0)
+        finally:
+            close_session(ssn)
+        metrics.update_e2e_duration(time.perf_counter() - start)

@@ -1,0 +1,100 @@
+"""vcctl CLI tests (mirrors pkg/cli/job/*_test.go output expectations)."""
+
+from __future__ import annotations
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.objects import JobPhase
+from volcano_tpu.cli import job as job_cli
+from volcano_tpu.cli import queue as queue_cli
+from volcano_tpu.cli.vcctl import DEMO_JOB_YAML
+from volcano_tpu.cluster import Cluster
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_resource_list_with_pods,
+)
+
+
+def make_cluster(nodes=3) -> Cluster:
+    cluster = Cluster()
+    for n in range(nodes):
+        cluster.store.create(build_node(
+            f"node-{n}", build_resource_list_with_pods("8", "16Gi")))
+    return cluster
+
+
+class TestJobCli:
+    def test_run_from_yaml(self):
+        cluster = make_cluster()
+        job = job_cli.run_job(cluster.store, DEMO_JOB_YAML)
+        assert job.metadata.name == "test-job"
+        assert job.spec.min_available == 3
+        assert [t.name for t in job.spec.tasks] == ["mpimaster", "mpiworker"]
+        assert "ssh" in job.spec.plugins
+
+        cluster.settle(4)
+        pods = cluster.store.list("Pod", namespace="default")
+        assert len(pods) == 3
+        assert all(p.status.phase == objects.POD_PHASE_RUNNING for p in pods)
+
+    def test_list_and_view(self):
+        cluster = make_cluster()
+        job_cli.run_job(cluster.store, DEMO_JOB_YAML)
+        cluster.settle(4)
+
+        table = job_cli.list_jobs(cluster.store, namespace="default")
+        lines = table.strip().splitlines()
+        assert lines[0].startswith("Name")
+        assert "test-job" in lines[1]
+        assert "Running" in lines[1]
+
+        view = job_cli.view_job(cluster.store, "default", "test-job")
+        assert "Name:       \ttest-job" in view
+        assert "mpiworker\treplicas: 2" in view
+
+    def test_suspend_resume_cycle(self):
+        cluster = make_cluster()
+        job_cli.run_job(cluster.store, DEMO_JOB_YAML)
+        cluster.settle(4)
+
+        job_cli.suspend_job(cluster.store, "default", "test-job")
+        cluster.settle(4)
+        stored = cluster.store.get("Job", "default", "test-job")
+        assert stored.status.state.phase == JobPhase.ABORTED
+        assert cluster.store.list("Pod", namespace="default") == []
+
+        job_cli.resume_job(cluster.store, "default", "test-job")
+        cluster.settle(6)
+        stored = cluster.store.get("Job", "default", "test-job")
+        assert stored.status.state.phase in (JobPhase.PENDING, JobPhase.RUNNING)
+        assert len(cluster.store.list("Pod", namespace="default")) == 3
+
+    def test_delete(self):
+        cluster = make_cluster()
+        job_cli.run_job(cluster.store, DEMO_JOB_YAML)
+        cluster.settle(2)
+        job_cli.delete_job(cluster.store, "default", "test-job")
+        assert cluster.store.try_get("Job", "default", "test-job") is None
+
+
+class TestQueueCli:
+    def test_create_get_list(self):
+        cluster = make_cluster()
+        queue_cli.create_queue(cluster.store, "gold", weight=5)
+        out = queue_cli.get_queue(cluster.store, "gold")
+        assert "gold" in out and "5" in out
+
+        table = queue_cli.list_queues(cluster.store)
+        lines = table.strip().splitlines()
+        assert lines[0].startswith("Name")
+        assert any("default" in line for line in lines)
+        assert any("gold" in line for line in lines)
+
+    def test_queue_status_columns(self):
+        cluster = make_cluster()
+        job_cli.run_job(cluster.store, DEMO_JOB_YAML)
+        cluster.settle(4)
+        out = queue_cli.get_queue(cluster.store, "default")
+        # one running podgroup aggregated into the queue status
+        row = out.strip().splitlines()[1].split()
+        assert row[0] == "default"
+        assert "1" in row  # running count

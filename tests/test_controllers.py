@@ -1,0 +1,345 @@
+"""Controller-manager tests: job lifecycle state machine, policy engine,
+job plugins, podgroup auto-creation, queue status, TTL GC
+(mirrors pkg/controllers/job/job_state_test.go and friends)."""
+
+from __future__ import annotations
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.objects import JobAction, JobEvent, JobPhase
+from volcano_tpu.controllers.garbagecollector import GarbageCollector
+from volcano_tpu.controllers.job import JobController
+from volcano_tpu.controllers.job.policies import apply_policies
+from volcano_tpu.controllers.apis import Request
+from volcano_tpu.controllers.podgroup import PodGroupController
+from volcano_tpu.controllers.queue import QueueController
+from volcano_tpu.store.store import Store
+
+
+def make_job(name="job1", namespace="ns1", min_available=2,
+             tasks=(("worker", 3),), plugins=None, policies=None,
+             task_policies=None, max_retry=3, ttl=None) -> objects.Job:
+    specs = []
+    for task_name, replicas in tasks:
+        specs.append(objects.TaskSpec(
+            name=task_name, replicas=replicas,
+            template=objects.PodTemplateSpec(
+                spec=objects.PodSpec(containers=[objects.Container(
+                    name="c", image="busybox",
+                    requests={"cpu": "1", "memory": "1Gi"})])),
+            policies=list(task_policies or []),
+        ))
+    job = objects.Job(
+        metadata=objects.ObjectMeta(name=name, namespace=namespace),
+        spec=objects.JobSpec(
+            min_available=min_available,
+            tasks=specs,
+            plugins=dict(plugins or {}),
+            policies=list(policies or []),
+            max_retry=max_retry,
+            ttl_seconds_after_finished=ttl,
+            queue="default",
+        ),
+    )
+    return job
+
+
+def set_pod_phase(store: Store, namespace: str, name: str, phase: str,
+                  exit_code: int = 0) -> None:
+    """Simulated kubelet: flip a pod's phase through the store."""
+    import copy
+
+    pod = store.get("Pod", namespace, name)
+    updated = copy.deepcopy(pod)
+    updated.status.phase = phase
+    if phase == objects.POD_PHASE_FAILED:
+        updated.status.container_statuses = [
+            objects.ContainerStatus(name="c", exit_code=exit_code)]
+    store.update_status(updated)
+
+
+def job_phase(store, job):
+    return store.get("Job", job.metadata.namespace, job.metadata.name).status.state.phase
+
+
+class TestJobSync:
+    def test_sync_creates_pods_and_podgroup(self):
+        store = Store()
+        cc = JobController(store)
+        job = make_job()
+        store.create(job)
+        cc.process_all()
+
+        pods = store.list("Pod", namespace="ns1")
+        assert len(pods) == 3
+        names = {p.metadata.name for p in pods}
+        assert names == {"job1-worker-0", "job1-worker-1", "job1-worker-2"}
+        for p in pods:
+            assert p.metadata.annotations[objects.JOB_NAME_KEY] == "job1"
+            assert p.metadata.annotations[objects.TASK_SPEC_KEY] == "worker"
+        pg = store.get("PodGroup", "ns1", "job1")
+        assert pg.spec.min_member == 2
+        assert pg.spec.min_resources["cpu"] == 2.0
+        assert job_phase(store, job) == JobPhase.PENDING
+
+    def test_pending_to_running_to_completed(self):
+        store = Store()
+        cc = JobController(store)
+        job = make_job(min_available=2, tasks=(("worker", 2),))
+        store.create(job)
+        cc.process_all()
+
+        for i in range(2):
+            set_pod_phase(store, "ns1", f"job1-worker-{i}", objects.POD_PHASE_RUNNING)
+        cc.process_all()
+        assert job_phase(store, job) == JobPhase.RUNNING
+
+        for i in range(2):
+            set_pod_phase(store, "ns1", f"job1-worker-{i}", objects.POD_PHASE_SUCCEEDED)
+        cc.process_all()
+        assert job_phase(store, job) == JobPhase.COMPLETED
+
+    def test_scale_replicas_diff(self):
+        store = Store()
+        cc = JobController(store)
+        job = make_job(min_available=1, tasks=(("worker", 3),))
+        store.create(job)
+        cc.process_all()
+        assert len(store.list("Pod", namespace="ns1")) == 3
+
+        # scale down to 1 replica -> extra pods deleted
+        import copy
+
+        updated = copy.deepcopy(store.get("Job", "ns1", "job1"))
+        updated.spec.tasks[0].replicas = 1
+        store.update(updated)
+        cc.process_all()
+        assert {p.metadata.name for p in store.list("Pod", namespace="ns1")} == {
+            "job1-worker-0"}
+
+
+class TestPolicies:
+    def test_pod_failed_restarts_job(self):
+        store = Store()
+        cc = JobController(store)
+        job = make_job(
+            min_available=2, tasks=(("worker", 2),),
+            policies=[objects.LifecyclePolicy(
+                event=JobEvent.POD_FAILED, action=JobAction.RESTART_JOB)])
+        store.create(job)
+        cc.process_all()
+        for i in range(2):
+            set_pod_phase(store, "ns1", f"job1-worker-{i}", objects.POD_PHASE_RUNNING)
+        cc.process_all()
+        assert job_phase(store, job) == JobPhase.RUNNING
+
+        set_pod_phase(store, "ns1", "job1-worker-0", objects.POD_PHASE_FAILED)
+        cc.process_all()
+        # restarted: back to Pending (pods recreated) and retry counted
+        stored = store.get("Job", "ns1", "job1")
+        assert stored.status.retry_count == 1
+        assert stored.status.state.phase in (JobPhase.PENDING, JobPhase.RUNNING)
+        assert len(store.list("Pod", namespace="ns1")) == 2
+
+    def test_exit_code_policy(self):
+        job = make_job(policies=[objects.LifecyclePolicy(
+            exit_code=137, action=JobAction.TERMINATE_JOB)])
+        req = Request(event=JobEvent.POD_FAILED, exit_code=137)
+        assert apply_policies(job, req) == JobAction.TERMINATE_JOB
+        req = Request(event=JobEvent.POD_FAILED, exit_code=1)
+        assert apply_policies(job, req) == JobAction.SYNC_JOB
+
+    def test_task_policies_override_job_policies(self):
+        job = make_job(
+            tasks=(("worker", 1),),
+            policies=[objects.LifecyclePolicy(
+                event=JobEvent.POD_FAILED, action=JobAction.ABORT_JOB)],
+            task_policies=[objects.LifecyclePolicy(
+                event=JobEvent.POD_FAILED, action=JobAction.RESTART_JOB)])
+        req = Request(task_name="worker", event=JobEvent.POD_FAILED)
+        assert apply_policies(job, req) == JobAction.RESTART_JOB
+
+    def test_stale_version_degrades_to_sync(self):
+        job = make_job(policies=[objects.LifecyclePolicy(
+            event=JobEvent.POD_FAILED, action=JobAction.RESTART_JOB)])
+        job.status.version = 5
+        req = Request(event=JobEvent.POD_FAILED, job_version=3)
+        assert apply_policies(job, req) == JobAction.SYNC_JOB
+
+    def test_max_retry_fails_job(self):
+        store = Store()
+        cc = JobController(store)
+        job = make_job(
+            min_available=1, tasks=(("worker", 1),), max_retry=2,
+            policies=[objects.LifecyclePolicy(
+                event=JobEvent.POD_FAILED, action=JobAction.RESTART_JOB)])
+        store.create(job)
+        cc.process_all()
+
+        for _ in range(4):
+            pods = store.list("Pod", namespace="ns1")
+            if not pods:
+                break
+            set_pod_phase(store, "ns1", pods[0].metadata.name,
+                          objects.POD_PHASE_FAILED)
+            cc.process_all()
+            if job_phase(store, job) == JobPhase.FAILED:
+                break
+        assert job_phase(store, job) == JobPhase.FAILED
+
+
+class TestCommands:
+    def test_abort_and_resume(self):
+        store = Store()
+        cc = JobController(store)
+        job = make_job(min_available=1, tasks=(("worker", 2),))
+        store.create(job)
+        cc.process_all()
+        assert len(store.list("Pod", namespace="ns1")) == 2
+
+        # vcctl job suspend == AbortJob Command (cli suspend.go)
+        store.create(objects.Command(
+            metadata=objects.ObjectMeta(name="abort-job1", namespace="ns1"),
+            action=JobAction.ABORT_JOB,
+            target_object=objects.OwnerReference(
+                kind=objects.Job.KIND, name="job1")))
+        cc.process_all()
+        assert job_phase(store, job) == JobPhase.ABORTED
+        assert store.list("Pod", namespace="ns1") == []
+        # command consumed exactly-once
+        assert store.list("Command", namespace="ns1") == []
+
+        store.create(objects.Command(
+            metadata=objects.ObjectMeta(name="resume-job1", namespace="ns1"),
+            action=JobAction.RESUME_JOB,
+            target_object=objects.OwnerReference(
+                kind=objects.Job.KIND, name="job1")))
+        cc.process_all()
+        stored = store.get("Job", "ns1", "job1")
+        assert stored.status.state.phase in (JobPhase.PENDING, JobPhase.RUNNING)
+        assert len(store.list("Pod", namespace="ns1")) == 2
+
+
+class TestJobPlugins:
+    def test_svc_ssh_env(self):
+        store = Store()
+        cc = JobController(store)
+        job = make_job(
+            min_available=2, tasks=(("mpimaster", 1), ("mpiworker", 2)),
+            plugins={"svc": [], "ssh": [], "env": []})
+        store.create(job)
+        cc.process_all()
+
+        # hostfile ConfigMap with task host lists (svc.go generateHost)
+        cm = store.get("ConfigMap", "ns1", "job1-svc")
+        assert cm.data["mpiworker.host"] == (
+            "job1-mpiworker-0.job1\njob1-mpiworker-1.job1")
+        assert cm.data["mpimaster.host"] == "job1-mpimaster-0.job1"
+        # headless service
+        svc = store.get("Service", "ns1", "job1")
+        assert svc.cluster_ip == "None"
+        # ssh keypair configmap
+        ssh_cm = store.get("ConfigMap", "ns1", "job1-ssh")
+        assert "id_rsa" in ssh_cm.data and "authorized_keys" in ssh_cm.data
+
+        pod = store.get("Pod", "ns1", "job1-mpiworker-1")
+        assert pod.spec.hostname == "job1-mpiworker-1"
+        assert pod.spec.subdomain == "job1"
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert env["VK_TASK_INDEX"] == "1"
+        mounts = [m.mount_path for m in pod.spec.containers[0].volume_mounts]
+        assert "/etc/volcano" in mounts and "/root/.ssh" in mounts
+
+    def test_plugin_resources_deleted_on_kill(self):
+        store = Store()
+        cc = JobController(store)
+        job = make_job(min_available=1, tasks=(("w", 1),),
+                       plugins={"svc": []})
+        store.create(job)
+        cc.process_all()
+        assert store.try_get("Service", "ns1", "job1") is not None
+
+        store.create(objects.Command(
+            metadata=objects.ObjectMeta(name="t", namespace="ns1"),
+            action=JobAction.TERMINATE_JOB,
+            target_object=objects.OwnerReference(kind="Job", name="job1")))
+        cc.process_all()
+        assert store.try_get("Service", "ns1", "job1") is None
+        assert store.try_get("ConfigMap", "ns1", "job1-svc") is None
+
+
+class TestPodGroupController:
+    def test_bare_pod_gets_podgroup(self):
+        store = Store()
+        pgc = PodGroupController(store, scheduler_name="volcano")
+        pod = objects.Pod(
+            metadata=objects.ObjectMeta(name="bare", namespace="ns1"),
+            spec=objects.PodSpec(scheduler_name="volcano"))
+        pod.metadata.ensure_identity()
+        store.create(pod)
+        pgc.process_all()
+
+        pod = store.get("Pod", "ns1", "bare")
+        group = pod.metadata.annotations[objects.GROUP_NAME_ANNOTATION_KEY]
+        pg = store.get("PodGroup", "ns1", group)
+        assert pg.spec.min_member == 1
+        assert pg.metadata.owner_references[0].name == "bare"
+
+    def test_other_scheduler_ignored(self):
+        store = Store()
+        pgc = PodGroupController(store, scheduler_name="volcano")
+        pod = objects.Pod(
+            metadata=objects.ObjectMeta(name="k8s-pod", namespace="ns1"),
+            spec=objects.PodSpec(scheduler_name="default-scheduler"))
+        pod.metadata.ensure_identity()
+        store.create(pod)
+        assert pgc.process_all() == 0
+        assert store.list("PodGroup", namespace="ns1") == []
+
+
+class TestQueueController:
+    def test_status_aggregation(self):
+        store = Store()
+        qc = QueueController(store)
+        q = objects.Queue(metadata=objects.ObjectMeta(name="default"))
+        q.metadata.ensure_identity()
+        store.create(q)
+        phases = [objects.PodGroupPhase.PENDING, objects.PodGroupPhase.RUNNING,
+                  objects.PodGroupPhase.RUNNING, objects.PodGroupPhase.INQUEUE]
+        for i, phase in enumerate(phases):
+            pg = objects.PodGroup(
+                metadata=objects.ObjectMeta(name=f"pg{i}", namespace="ns1"),
+                spec=objects.PodGroupSpec(queue="default"),
+                status=objects.PodGroupStatus(phase=phase))
+            pg.metadata.ensure_identity()
+            store.create(pg)
+        qc.process_all()
+        status = store.get("Queue", "", "default").status
+        assert (status.pending, status.running, status.inqueue) == (1, 2, 1)
+
+
+class TestGarbageCollector:
+    def test_ttl_cleanup(self):
+        store = Store()
+        now = [1000.0]
+        gc = GarbageCollector(store, clock=lambda: now[0])
+        job = make_job(ttl=60)
+        job.status.state.phase = JobPhase.COMPLETED
+        job.status.state.last_transition_time = 1000.0
+        store.create(job)
+
+        assert gc.process_expired() == 0  # not expired yet
+        now[0] = 1061.0
+        assert gc.process_expired() == 1
+        assert store.try_get("Job", "ns1", "job1") is None
+
+    def test_no_ttl_never_collected(self):
+        store = Store()
+        now = [1000.0]
+        gc = GarbageCollector(store, clock=lambda: now[0])
+        job = make_job(ttl=None)
+        job.status.state.phase = JobPhase.COMPLETED
+        job.status.state.last_transition_time = 1000.0
+        store.create(job)
+        now[0] = 1e9
+        assert gc.process_expired() == 0
+        assert store.try_get("Job", "ns1", "job1") is not None

@@ -1,0 +1,231 @@
+"""Single-host integration: the full submit -> enqueue -> allocate -> bind
+-> run -> complete pipeline over the in-process cluster with a simulated
+kubelet (SURVEY.md §4 tier 3; mirrors the reference's kind-cluster e2e
+coverage: job_scheduling.go, job_lifecycle.go, job_plugins.go, mpi.go)."""
+
+from __future__ import annotations
+
+import copy
+
+from tests.test_controllers import make_job
+from volcano_tpu.api import objects
+from volcano_tpu.api.objects import JobAction, JobEvent, JobPhase
+from volcano_tpu.cluster import Cluster
+from volcano_tpu.scheduler.scheduler import TPU_SCHEDULER_CONF
+from volcano_tpu.scheduler.util.test_utils import build_node, build_resource_list_with_pods
+from volcano_tpu.store.store import AdmissionError
+
+import pytest
+
+
+def make_cluster(nodes=3, cpu="8", mem="16Gi", **kwargs) -> Cluster:
+    cluster = Cluster(**kwargs)
+    for n in range(nodes):
+        node = build_node(f"node-{n}", build_resource_list_with_pods(cpu, mem))
+        cluster.store.create(node)
+    return cluster
+
+
+def finish_pods(cluster: Cluster, phase=objects.POD_PHASE_SUCCEEDED) -> None:
+    for pod in cluster.store.list("Pod"):
+        if pod.status.phase == objects.POD_PHASE_RUNNING:
+            updated = copy.deepcopy(pod)
+            updated.status.phase = phase
+            cluster.store.update_status(updated)
+
+
+def job_state(cluster, name="job1", namespace="ns1"):
+    return cluster.store.get("Job", namespace, name).status.state.phase
+
+
+class TestPipeline:
+    def test_submit_to_completed(self):
+        cluster = make_cluster()
+        job = make_job(min_available=2, tasks=(("worker", 2),))
+        job.spec.scheduler_name = "volcano"
+        cluster.store.create(job)
+
+        cluster.settle(4)
+        # pods created, gated until Inqueue, then bound and started
+        pods = cluster.store.list("Pod", namespace="ns1")
+        assert len(pods) == 2
+        assert all(p.spec.node_name for p in pods)
+        assert all(p.status.phase == objects.POD_PHASE_RUNNING for p in pods)
+        assert job_state(cluster) == JobPhase.RUNNING
+        pg = cluster.store.get("PodGroup", "ns1", "job1")
+        assert pg.status.phase == objects.PodGroupPhase.RUNNING
+
+        finish_pods(cluster)
+        cluster.settle(3)
+        assert job_state(cluster) == JobPhase.COMPLETED
+
+    def test_delay_pod_creation_gate(self):
+        # without capacity the PodGroup stays Pending and pods are never
+        # admitted (docs/design/delay-pod-creation.md)
+        cluster = make_cluster(nodes=0)
+        job = make_job(min_available=2, tasks=(("worker", 2),))
+        job.spec.scheduler_name = "volcano"
+        cluster.store.create(job)
+        cluster.settle(3)
+        assert cluster.store.list("Pod", namespace="ns1") == []
+        pg = cluster.store.get("PodGroup", "ns1", "job1")
+        assert pg.status.phase == objects.PodGroupPhase.PENDING
+
+    def test_gang_all_or_nothing_across_jobs(self):
+        # one node fits only one 4-gang; second job waits entirely
+        cluster = make_cluster(nodes=1, cpu="4", mem="8Gi")
+        for name in ("gang-a", "gang-b"):
+            job = make_job(name=name, min_available=4, tasks=(("w", 4),))
+            job.spec.scheduler_name = "volcano"
+            cluster.store.create(job)
+        cluster.settle(4)
+        bound = {p.metadata.annotations[objects.JOB_NAME_KEY]
+                 for p in cluster.store.list("Pod") if p.spec.node_name}
+        assert len(bound) == 1  # exactly one whole gang
+
+    def test_tpu_conf_pipeline(self):
+        cluster = make_cluster(scheduler_conf=TPU_SCHEDULER_CONF)
+        job = make_job(min_available=2, tasks=(("worker", 2),))
+        job.spec.scheduler_name = "volcano"
+        cluster.store.create(job)
+        cluster.settle(4)
+        pods = cluster.store.list("Pod", namespace="ns1")
+        assert len(pods) == 2 and all(p.spec.node_name for p in pods)
+
+
+class TestMPIRendezvous:
+    def test_mpi_job_hostfile_and_keys(self):
+        """The reference's e2e MPI flow (test/e2e/mpi.go:26-78): master +
+        workers with svc/ssh plugins; hostfile lists worker DNS names."""
+        cluster = make_cluster()
+        job = make_job(
+            name="lm-mpi-job", min_available=3,
+            tasks=(("mpimaster", 1), ("mpiworker", 2)),
+            plugins={"ssh": [], "svc": []})
+        job.spec.scheduler_name = "volcano"
+        cluster.store.create(job)
+        cluster.settle(4)
+
+        cm = cluster.store.get("ConfigMap", "ns1", "lm-mpi-job-svc")
+        assert cm.data["mpiworker.host"].splitlines() == [
+            "lm-mpi-job-mpiworker-0.lm-mpi-job",
+            "lm-mpi-job-mpiworker-1.lm-mpi-job",
+        ]
+        assert "id_rsa" in cluster.store.get("ConfigMap", "ns1", "lm-mpi-job-ssh").data
+        pods = cluster.store.list("Pod", namespace="ns1")
+        assert len(pods) == 3
+        assert all(p.status.phase == objects.POD_PHASE_RUNNING for p in pods)
+        # every pod has a stable DNS identity for rendezvous
+        assert {p.spec.hostname for p in pods} == {p.metadata.name for p in pods}
+        assert {p.spec.subdomain for p in pods} == {"lm-mpi-job"}
+
+
+class TestLifecyclePolicies:
+    def test_pod_failure_restarts_and_reschedules(self):
+        cluster = make_cluster()
+        job = make_job(
+            min_available=2, tasks=(("worker", 2),),
+            policies=[objects.LifecyclePolicy(
+                event=JobEvent.POD_FAILED, action=JobAction.RESTART_JOB)])
+        job.spec.scheduler_name = "volcano"
+        cluster.store.create(job)
+        cluster.settle(4)
+        assert job_state(cluster) == JobPhase.RUNNING
+
+        # kill one pod -> RestartJob -> pods recreated and rescheduled
+        victim = cluster.store.list("Pod", namespace="ns1")[0]
+        updated = copy.deepcopy(victim)
+        updated.status.phase = objects.POD_PHASE_FAILED
+        updated.status.container_statuses = [
+            objects.ContainerStatus(name="c", exit_code=1)]
+        cluster.store.update_status(updated)
+
+        cluster.settle(6)
+        stored = cluster.store.get("Job", "ns1", "job1")
+        assert stored.status.retry_count >= 1
+        assert stored.status.state.phase == JobPhase.RUNNING
+        pods = cluster.store.list("Pod", namespace="ns1")
+        assert len(pods) == 2
+        assert all(p.status.phase == objects.POD_PHASE_RUNNING for p in pods)
+
+    def test_ttl_garbage_collection(self):
+        cluster = make_cluster()
+        job = make_job(min_available=1, tasks=(("w", 1),), ttl=0)
+        job.spec.scheduler_name = "volcano"
+        cluster.store.create(job)
+        cluster.settle(4)
+        finish_pods(cluster)
+        cluster.settle(4)
+        # ttl=0: collected as soon as it finishes
+        assert cluster.store.try_get("Job", "ns1", "job1") is None
+
+
+class TestAdmission:
+    def test_invalid_jobs_rejected(self):
+        cluster = make_cluster()
+        bad = make_job(min_available=0)
+        with pytest.raises(AdmissionError, match="minAvailable"):
+            cluster.store.create(bad)
+
+        bad = make_job(min_available=5, tasks=(("w", 2),))
+        with pytest.raises(AdmissionError, match="total replicas"):
+            cluster.store.create(bad)
+
+        bad = make_job(tasks=(("w", 2), ("w", 1)), min_available=1)
+        with pytest.raises(AdmissionError, match="duplicated task name"):
+            cluster.store.create(bad)
+
+        bad = make_job(min_available=1, tasks=(("UPPER", 1),))
+        with pytest.raises(AdmissionError, match="RFC 1123"):
+            cluster.store.create(bad)
+
+        bad = make_job(min_available=1, tasks=(("w", 1),),
+                       policies=[objects.LifecyclePolicy(
+                           event=JobEvent.POD_FAILED, exit_code=3,
+                           action=JobAction.ABORT_JOB)])
+        with pytest.raises(AdmissionError, match="simultaneously"):
+            cluster.store.create(bad)
+
+        bad = make_job(min_available=1, tasks=(("w", 1),))
+        bad.spec.queue = "no-such-queue"
+        with pytest.raises(AdmissionError, match="queue"):
+            cluster.store.create(bad)
+
+        bad = make_job(min_available=1, tasks=(("w", 1),),
+                       plugins={"teleport": []})
+        with pytest.raises(AdmissionError, match="job plugin"):
+            cluster.store.create(bad)
+
+    def test_mutation_defaults(self):
+        cluster = make_cluster()
+        job = make_job(min_available=1, tasks=(("", 1),))
+        job.spec.queue = ""
+        cluster.store.create(job)
+        stored = cluster.store.get("Job", "ns1", "job1")
+        assert stored.spec.queue == "default"
+        assert stored.spec.tasks[0].name == "task0"
+
+
+class TestThreadedCluster:
+    def test_threaded_pipeline(self):
+        cluster = make_cluster(schedule_period=0.05)
+        cluster.run()
+        try:
+            job = make_job(min_available=2, tasks=(("worker", 2),))
+            job.spec.scheduler_name = "volcano"
+            cluster.store.create(job)
+
+            import time
+
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                pods = cluster.store.list("Pod", namespace="ns1")
+                if (len(pods) == 2 and all(
+                        p.status.phase == objects.POD_PHASE_RUNNING
+                        for p in pods)):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("pods never started running")
+        finally:
+            cluster.stop()

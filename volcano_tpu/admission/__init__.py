@@ -1,0 +1,11 @@
+"""Admission: job validation/mutation + the delay-pod-creation gate
+(volcano pkg/admission/)."""
+
+from volcano_tpu.admission.admission import (
+    install,
+    mutate_job,
+    validate_job,
+    validate_pod,
+)
+
+__all__ = ["install", "mutate_job", "validate_job", "validate_pod"]

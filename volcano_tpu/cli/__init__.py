@@ -1,0 +1,1 @@
+"""vcctl-analog CLI (volcano pkg/cli/{job,queue} + cmd/cli/vcctl.go)."""

@@ -1,0 +1,113 @@
+"""vcctl — the CLI entry (volcano cmd/cli/vcctl.go:34).
+
+The reference talks to an API server; this framework's state store is
+in-process, so the CLI binds to a cluster instance: either the interactive
+``demo`` subcommand (spins a full Cluster, runs a job end-to-end, prints the
+tables) or library use against any Store (see cli/job.py, cli/queue.py).
+A networked mode arrives with the gRPC bridge (SURVEY.md §7 stage 5).
+
+    python -m volcano_tpu.cli.vcctl demo
+    python -m volcano_tpu.cli.vcctl demo --job example/job.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from volcano_tpu.cli import job as job_cli
+from volcano_tpu.cli import queue as queue_cli
+
+DEMO_JOB_YAML = """
+apiVersion: batch.volcano.sh/v1alpha1
+kind: Job
+metadata:
+  name: test-job
+  namespace: default
+spec:
+  minAvailable: 3
+  schedulerName: volcano
+  queue: default
+  plugins:
+    ssh: []
+    env: []
+    svc: []
+  policies:
+    - event: PodEvicted
+      action: RestartJob
+  tasks:
+    - replicas: 1
+      name: mpimaster
+      template:
+        spec:
+          containers:
+            - image: mpi-image
+              name: mpimaster
+              resources:
+                requests:
+                  cpu: "500m"
+    - replicas: 2
+      name: mpiworker
+      template:
+        spec:
+          containers:
+            - image: mpi-image
+              name: mpiworker
+              resources:
+                requests:
+                  cpu: "1000m"
+"""
+
+
+def demo(args) -> int:
+    from volcano_tpu.cluster import Cluster
+    from volcano_tpu.scheduler.util.test_utils import (
+        build_node, build_resource_list_with_pods)
+
+    yaml_text = DEMO_JOB_YAML
+    if args.job:
+        with open(args.job) as f:
+            yaml_text = f.read()
+
+    cluster = Cluster()
+    for n in range(args.nodes):
+        cluster.store.create(build_node(
+            f"node-{n}", build_resource_list_with_pods("8", "16Gi")))
+
+    print(f"# vcctl job run -f {args.job or '<demo>'}")
+    job = job_cli.run_job(cluster.store, yaml_text)
+    cluster.settle(5)
+
+    print("# vcctl job list")
+    print(job_cli.list_jobs(cluster.store, namespace=job.metadata.namespace))
+    print(f"# vcctl job view -n {job.metadata.namespace} -N {job.metadata.name}")
+    print(job_cli.view_job(cluster.store, job.metadata.namespace, job.metadata.name))
+    print("# vcctl queue list")
+    print(queue_cli.list_queues(cluster.store))
+
+    print(f"# vcctl job suspend -N {job.metadata.name}")
+    job_cli.suspend_job(cluster.store, job.metadata.namespace, job.metadata.name)
+    cluster.settle(4)
+    print(job_cli.list_jobs(cluster.store, namespace=job.metadata.namespace))
+
+    print(f"# vcctl job resume -N {job.metadata.name}")
+    job_cli.resume_job(cluster.store, job.metadata.namespace, job.metadata.name)
+    cluster.settle(6)
+    print(job_cli.list_jobs(cluster.store, namespace=job.metadata.namespace))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="vcctl")
+    sub = ap.add_subparsers(dest="command", required=True)
+    demo_p = sub.add_parser("demo", help="run a full in-process cluster demo")
+    demo_p.add_argument("--job", help="job YAML file (default: built-in MPI-style job)")
+    demo_p.add_argument("--nodes", type=int, default=3)
+    args = ap.parse_args(argv)
+    if args.command == "demo":
+        return demo(args)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

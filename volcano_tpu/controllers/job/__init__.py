@@ -1,0 +1,5 @@
+"""Job controller (volcano pkg/controllers/job/)."""
+
+from volcano_tpu.controllers.job.controller import JobController
+
+__all__ = ["JobController"]

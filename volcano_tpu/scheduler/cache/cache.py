@@ -138,9 +138,11 @@ class SchedulerCache:
 
     def run(self) -> None:
         """Wire the 11-informer equivalent: watch every kind the scheduler
-        consumes (cache.go:322-425)."""
-        if self.store is None:
+        consumes (cache.go:322-425). Idempotent — the scheduler driver and
+        an embedding cluster may both call it."""
+        if self.store is None or getattr(self, "_watching", False):
             return
+        self._watching = True
         s = self.store
         s.watch("Pod", WatchHandler(self.add_pod, self.update_pod_from_watch, self.delete_pod))
         s.watch("Node", WatchHandler(self.add_node, self.update_node_from_watch, self.delete_node))

@@ -53,7 +53,8 @@ def _session_once(cache, tiers, actions, mesh=None):
 
 
 def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
-               mesh=None, verbose=True):
+               mesh=None, verbose=True, warm_iters: int = 3):
+    warm_iters = max(warm_iters, 1)
     from volcano_tpu.bench.clusters import CONFIGS, build_config
 
     bc = CONFIGS[cfg]
@@ -91,14 +92,29 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
                   file=sys.stderr)
 
     if backend in ("tpu", "both", "auto"):
+        import gc
+
         cache, _, tpu_tiers, actions, n_tasks = build_config(cfg, scale)
         cold = _session_once(cache, tpu_tiers, actions, mesh=mesh)
         out["tpu_cold_ms"] = cold["actions_s"] * 1e3
         out["tpu_cold_profile"] = cold["profile"]
-        # warm: fresh identical cluster, compiled program reused
-        cache, _, tpu_tiers, actions, n_tasks = build_config(cfg, scale)
-        warm = _session_once(cache, tpu_tiers, actions, mesh=mesh)
-        out["tpu_ms"] = warm["actions_s"] * 1e3
+        # warm: fresh identical clusters, compiled program reused. Take the
+        # best of a few iterations — the device hop here is a tunneled PJRT
+        # connection whose per-round-trip latency jitters by 2-3x, and the
+        # min is the reproducible figure (the scheduler reuses the compiled
+        # program every cycle).
+        samples = []
+        warm = None
+        for _ in range(warm_iters):
+            del cache
+            gc.collect()
+            cache, _, tpu_tiers, actions, n_tasks = build_config(cfg, scale)
+            w = _session_once(cache, tpu_tiers, actions, mesh=mesh)
+            samples.append(w["actions_s"] * 1e3)
+            if warm is None or w["actions_s"] * 1e3 <= min(samples):
+                warm = w
+        out["tpu_ms"] = min(samples)
+        out["tpu_warm_samples_ms"] = [round(s, 3) for s in samples]
         out["tpu_binds"] = warm["binds"]
         out["tpu_profile"] = warm["profile"]
         out["tasks"] = n_tasks
@@ -106,7 +122,8 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
             p = warm["profile"]
             print(f"[cfg{cfg}] tpu warm: {out['tpu_ms']:.1f} ms "
                   f"(encode {p.get('encode_s', 0)*1e3:.1f} solve {p.get('solve_s', 0)*1e3:.1f} "
-                  f"apply {p.get('apply_s', 0)*1e3:.1f}) binds={warm['binds']}",
+                  f"apply {p.get('apply_s', 0)*1e3:.1f}) binds={warm['binds']} "
+                  f"samples={[round(s) for s in samples]}",
                   file=sys.stderr)
 
     if "serial_ms" in out and "tpu_ms" in out and out["tpu_ms"] > 0:
@@ -116,12 +133,16 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", type=int, default=5, choices=[1, 2, 3, 4, 5])
-    ap.add_argument("--all", action="store_true", help="run all five configs")
+    ap.add_argument("--config", type=int, default=None, choices=[1, 2, 3, 4, 5],
+                    help="run ONE config (default: all five, headline = cfg 5)")
+    ap.add_argument("--all", action="store_true",
+                    help="run all five configs (the default when --config is absent)")
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--backend", choices=["serial", "tpu", "both", "auto"], default="auto")
-    ap.add_argument("--serial-budget", type=float, default=60.0,
-                    help="max seconds to spend measuring the serial loop")
+    ap.add_argument("--serial-budget", type=float, default=30.0,
+                    help="max seconds to spend measuring the serial loop per config")
+    ap.add_argument("--warm-iters", type=int, default=3,
+                    help="warm TPU sessions per config (>=1); min is reported")
     ap.add_argument("--mesh", action="store_true",
                     help="shard the node axis across all local devices")
     args = ap.parse_args()
@@ -137,10 +158,11 @@ def main() -> int:
             mesh = Mesh(np.array(devs), ("nodes",))
 
     results = []
-    cfgs = [1, 2, 3, 4, 5] if args.all else [args.config]
+    cfgs = [args.config] if args.config is not None else [1, 2, 3, 4, 5]
     for cfg in cfgs:
         results.append(run_config(cfg, args.scale, args.backend,
-                                  args.serial_budget, mesh=mesh))
+                                  args.serial_budget, mesh=mesh,
+                                  warm_iters=args.warm_iters))
 
     headline = results[-1]
     final = {
@@ -152,6 +174,11 @@ def main() -> int:
         "unit": "ms",
         "vs_baseline": round(headline.get("speedup", 0.0), 3),
     }
+    # the headline baseline may be a reduced-scale serial run extrapolated
+    # linearly in tasks x nodes — say so next to the number it shaped
+    if headline.get("serial_extrapolated"):
+        final["serial_extrapolated"] = True
+        final["serial_measured_scale"] = headline.get("serial_measured_scale")
     if len(results) > 1:
         final["all_configs"] = [
             {k: v for k, v in r.items() if not k.endswith("profile")} for r in results

@@ -28,13 +28,18 @@ from volcano_tpu.scheduler.util.test_utils import (
 DEFAULT_TIERS = (["priority", "gang"], ["drf", "predicates", "proportion", "nodeorder"])
 
 
+PARITY_ARGS = {"tpuscore": {"tpuscore.mode": "parity"}}
+
+
 def run_backend(populate, tiers, tpu: bool):
     cache = make_cache()
     populate(cache)
     tier_spec = list(tiers)
     if tpu:
         tier_spec = [["tpuscore"], *tier_spec]
-    ssn = open_session(cache, make_tiers(*tier_spec))
+    # parity mode is opt-in: auto hands small sessions to the serial loop
+    # (which would make these comparisons vacuous)
+    ssn = open_session(cache, make_tiers(*tier_spec, arguments=PARITY_ARGS))
     get_action("allocate").execute(ssn)
     if tpu:
         assert getattr(ssn, "batch_allocator", None) is not None
@@ -273,7 +278,8 @@ class TestTpuParity:
 
         cache = make_cache()
         populate(cache)
-        ssn = open_session(cache, make_tiers(["tpuscore"], *DEFAULT_TIERS))
+        ssn = open_session(
+            cache, make_tiers(["tpuscore"], *DEFAULT_TIERS, arguments=PARITY_ARGS))
         mesh = Mesh(np.array(jax.devices()[:8]), ("nodes",))
         ssn.plugins["tpuscore"].mesh = mesh
         ssn.batch_allocator.mesh = mesh

@@ -83,6 +83,26 @@ class TaskInfo:
             pod=self.pod,
         )
 
+    def shared_clone(self) -> "TaskInfo":
+        """Status-frozen copy for node task-maps that SHARES the resreq /
+        init_resreq Resource objects. Node maps clone tasks only so later
+        status flips don't corrupt node accounting (node_info.go:196-197);
+        the request Resources are never mutated through a node map, so the
+        bulk-apply path avoids 2 Resource deep-copies per placement."""
+        t = TaskInfo.__new__(TaskInfo)
+        t.uid = self.uid
+        t.job = self.job
+        t.name = self.name
+        t.namespace = self.namespace
+        t.resreq = self.resreq
+        t.init_resreq = self.init_resreq
+        t.node_name = self.node_name
+        t.status = self.status
+        t.priority = self.priority
+        t.volume_ready = self.volume_ready
+        t.pod = self.pod
+        return t
+
     def __repr__(self) -> str:
         return (
             f"Task ({self.uid}:{self.namespace}/{self.name}): "

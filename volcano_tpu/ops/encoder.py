@@ -87,6 +87,8 @@ class EncodedSnapshot:
     job_infos: List[JobInfo] = field(default_factory=list)
     node_names: List[str] = field(default_factory=list)
     resource_names: List[str] = field(default_factory=list)
+    ns_names: List[str] = field(default_factory=list)
+    queue_uids: List[str] = field(default_factory=list)
     num_to_find: int = 0
     rr0: int = 0
 
@@ -105,15 +107,44 @@ class EncodedSnapshot:
 def _signature_key(pod: Optional[objects.Pod]) -> str:
     if pod is None:
         return "<none>"
-    parts = [repr(sorted(pod.spec.node_selector.items()))]
-    aff = pod.spec.affinity
+    spec = pod.spec
+    if not spec.node_selector and spec.affinity is None and not spec.tolerations:
+        return "<plain>"
+    parts = [repr(sorted(spec.node_selector.items()))]
+    aff = spec.affinity
     if aff is not None and aff.node_affinity is not None:
         parts.append(repr([_term_repr(t) for t in aff.node_affinity.required_terms]))
         parts.append(
             repr([(p.weight, _term_repr(p.preference)) for p in aff.node_affinity.preferred_terms])
         )
-    parts.append(repr([(t.key, t.operator, t.value, t.effect) for t in pod.spec.tolerations]))
+    parts.append(repr([(t.key, t.operator, t.value, t.effect) for t in spec.tolerations]))
     return "|".join(parts)
+
+
+def _pod_encode_traits(pod: objects.Pod):
+    """(signature key, has_host_ports, has_pod_affinity), cached on the pod.
+
+    Pod objects persist across sessions (snapshot clones TaskInfos but
+    shares the pod reference), so caching amortizes the per-task
+    string/scan work of the encoder's hot loop to one computation per pod
+    *version*: the store bumps metadata.resource_version on every
+    create/update (store.py:121-136), including in-place mutations
+    re-stored by effectors, so the cache is keyed on it and recomputes
+    whenever the pod changed."""
+    rv = pod.metadata.resource_version
+    try:
+        cached_rv, traits = pod._enc_traits
+        if cached_rv == rv:
+            return traits
+    except AttributeError:
+        pass
+    traits = (
+        _signature_key(pod),
+        _has_host_ports(pod),
+        _has_pod_affinity(pod),
+    )
+    pod._enc_traits = (rv, traits)
+    return traits
 
 
 def _term_repr(term) -> str:
@@ -130,7 +161,13 @@ def _has_pod_affinity(pod: Optional[objects.Pod]) -> bool:
 def _has_host_ports(pod: Optional[objects.Pod]) -> bool:
     if pod is None:
         return False
-    return any(p.host_port > 0 for c in pod.spec.containers for p in c.ports)
+    # plain loops: this runs per fresh pod in the encoder hot path and a
+    # genexpr-under-any costs ~3x the common no-ports case
+    for c in pod.spec.containers:
+        for p in c.ports:
+            if p.host_port > 0:
+                return True
+    return False
 
 
 def _static_node_ok(node: NodeInfo, memory_p: bool, disk_p: bool, pid_p: bool) -> bool:
@@ -200,9 +237,12 @@ def encode_session(ssn) -> EncodedSnapshot:
         if not node.releasing.is_empty():
             raise EncoderFallback("releasing resources (pipeline path) not modeled")
         for t in node.tasks.values():
-            if _has_pod_affinity(t.pod):
+            if t.pod is None:
+                continue
+            _, ports, aff = _pod_encode_traits(t.pod)
+            if aff:
                 raise EncoderFallback("pod (anti-)affinity not modeled")
-            if _has_host_ports(t.pod):
+            if ports:
                 raise EncoderFallback("host ports not modeled")
 
     # ---- eligible jobs (allocate.go:49-76 filter) --------------------------
@@ -222,10 +262,13 @@ def encode_session(ssn) -> EncodedSnapshot:
     scalar_names: set = set()
     for job in jobs:
         for task in job.tasks.values():
-            for res in (task.resreq, task.init_resreq):
-                scalar_names.update(res.scalar_resources or {})
+            if task.resreq.scalar_resources:
+                scalar_names.update(task.resreq.scalar_resources)
+            if task.init_resreq.scalar_resources:
+                scalar_names.update(task.init_resreq.scalar_resources)
     for node in nodes:
-        scalar_names.update(node.allocatable.scalar_resources or {})
+        if node.allocatable.scalar_resources:
+            scalar_names.update(node.allocatable.scalar_resources)
     rnames = ["cpu", "memory", *sorted(scalar_names)]
     R = len(rnames)
     eps = np.array(
@@ -275,15 +318,19 @@ def encode_session(ssn) -> EncodedSnapshot:
         job_task_start[ji] = len(task_infos)
         job_task_count[ji] = len(pending)
         for t in pending:
-            if _has_pod_affinity(t.pod):
-                raise EncoderFallback("pod (anti-)affinity not modeled")
-            if _has_host_ports(t.pod):
-                raise EncoderFallback("host ports not modeled")
-            key = _signature_key(t.pod)
-            if key not in sig_index:
-                sig_index[key] = len(sig_rep)
+            if t.pod is None:
+                key = "<none>"
+            else:
+                key, ports, aff = _pod_encode_traits(t.pod)
+                if aff:
+                    raise EncoderFallback("pod (anti-)affinity not modeled")
+                if ports:
+                    raise EncoderFallback("host ports not modeled")
+            si = sig_index.get(key)
+            if si is None:
+                si = sig_index[key] = len(sig_rep)
                 sig_rep.append(t)
-            task_sig.append(sig_index[key])
+            task_sig.append(si)
             task_infos.append(t)
     t_count = len(task_infos)
     s_count = max(len(sig_rep), 1)
@@ -348,10 +395,22 @@ def encode_session(ssn) -> EncodedSnapshot:
                     nodeorder_mod.node_affinity_score(rep, n) for n in nodes
                 ]
 
-    # ---- node state --------------------------------------------------------
-    node_idle = np.stack([_resource_vec(n.idle, rnames) for n in nodes]) if nodes else np.zeros((0, R))
-    node_used = np.stack([_resource_vec(n.used, rnames) for n in nodes]) if nodes else np.zeros((0, R))
-    node_alloc = np.stack([_resource_vec(n.allocatable, rnames) for n in nodes]) if nodes else np.zeros((0, R))
+    # ---- node state (column-wise fills, like the task arrays) --------------
+    def _node_matrix(attr: str) -> np.ndarray:
+        if not nodes:
+            return np.zeros((0, R))
+        m = np.zeros((n_count, R), np.float64)
+        ress = [getattr(n, attr) for n in nodes]
+        m[:, 0] = [r.milli_cpu for r in ress]
+        m[:, 1] = [r.memory for r in ress]
+        for si, rn in enumerate(rnames[2:], start=2):
+            m[:, si] = [
+                (r.scalar_resources or {}).get(rn, 0.0) for r in ress]
+        return m
+
+    node_idle = _node_matrix("idle")
+    node_used = _node_matrix("used")
+    node_alloc = _node_matrix("allocatable")
     node_cnt = np.array([len(n.tasks) for n in nodes], np.int32)
     node_max_tasks = np.array([n.allocatable.max_task_num for n in nodes], np.int32)
 
@@ -513,6 +572,8 @@ def encode_session(ssn) -> EncodedSnapshot:
         job_infos=jobs,
         node_names=node_names,
         resource_names=rnames,
+        ns_names=ns_names,
+        queue_uids=queue_ids,
         num_to_find=scheduler_helper.calculate_num_of_feasible_nodes_to_find(n_count),
         rr0=scheduler_helper._last_processed_node_index,
     )

@@ -83,40 +83,48 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
 
     def one_chunk(ci):
         sl = ci * chunk
-        req = lax.dynamic_slice_in_dim(enc["task_req"], sl, chunk)
-        initreq = lax.dynamic_slice_in_dim(enc["task_initreq"], sl, chunk)
-        sig = lax.dynamic_slice_in_dim(enc["task_sig"], sl, chunk)
-        nz_cpu = lax.dynamic_slice_in_dim(enc["task_nz_cpu"], sl, chunk)
-        nz_mem = lax.dynamic_slice_in_dim(enc["task_nz_mem"], sl, chunk)
-        has_pod = lax.dynamic_slice_in_dim(enc["task_has_pod"], sl, chunk)
         act = lax.dynamic_slice_in_dim(active, sl, chunk)
 
-        # epsilon fit of init requests against idle (resource_info.go:267)
-        le = initreq[:, None, :] < idle[None, :, :] + eps[None, None, :]
-        skip = is_scalar[None, None, :] & (initreq[:, None, :] <= MIN_MILLI_SCALAR)
-        fit = jnp.all(le | skip, axis=-1)                     # [C, N]
-        mask = fit & enc["sig_mask"][sig]
-        if spec.check_pod_count:
-            mask = mask & ((cnt[None, :] < enc["node_max_tasks"][None, :])
-                           | ~has_pod[:, None])
-        mask = mask & act[:, None]
+        def sweep(_):
+            req = lax.dynamic_slice_in_dim(enc["task_req"], sl, chunk)
+            initreq = lax.dynamic_slice_in_dim(enc["task_initreq"], sl, chunk)
+            sig = lax.dynamic_slice_in_dim(enc["task_sig"], sl, chunk)
+            nz_cpu = lax.dynamic_slice_in_dim(enc["task_nz_cpu"], sl, chunk)
+            nz_mem = lax.dynamic_slice_in_dim(enc["task_nz_mem"], sl, chunk)
+            has_pod = lax.dynamic_slice_in_dim(enc["task_has_pod"], sl, chunk)
 
-        score = fused_scores(spec, enc, used, req, nz_cpu, nz_mem, sig)
-        masked = jnp.where(mask, score, neg)
-        # deterministic tie spreading: scores are coarse (floor-based), so
-        # whole gangs tie on one node and would fill the cluster one node
-        # per round; among the tied best nodes, task t takes the
-        # (t mod n_tied)-th — exact-tie-only, score order is untouched
-        # (divergence from the serial min-name tie-break, see module doc)
-        m = jnp.max(masked, axis=-1, keepdims=True)
-        tied = (masked == m) & mask                       # [C, N]
-        n_tied = jnp.sum(tied, axis=-1)                   # [C]
-        t_idx = sl + jnp.arange(chunk)
-        kth = (t_idx % jnp.maximum(n_tied, 1)).astype(jnp.int32)
-        csum = jnp.cumsum(tied.astype(jnp.int32), axis=-1)
-        best = jnp.argmax(tied & (csum == (kth + 1)[:, None]), axis=-1).astype(jnp.int32)
-        feasible = jnp.any(mask, axis=-1)
-        return jnp.where(feasible, best, -1)
+            # epsilon fit of init requests against idle (resource_info.go:267)
+            le = initreq[:, None, :] < idle[None, :, :] + eps[None, None, :]
+            skip = is_scalar[None, None, :] & (initreq[:, None, :] <= MIN_MILLI_SCALAR)
+            fit = jnp.all(le | skip, axis=-1)                     # [C, N]
+            mask = fit & enc["sig_mask"][sig]
+            if spec.check_pod_count:
+                mask = mask & ((cnt[None, :] < enc["node_max_tasks"][None, :])
+                               | ~has_pod[:, None])
+            mask = mask & act[:, None]
+
+            score = fused_scores(spec, enc, used, req, nz_cpu, nz_mem, sig)
+            masked = jnp.where(mask, score, neg)
+            # deterministic tie spreading: scores are coarse (floor-based), so
+            # whole gangs tie on one node and would fill the cluster one node
+            # per round; among the tied best nodes, task t takes the
+            # (t mod n_tied)-th — exact-tie-only, score order is untouched
+            # (divergence from the serial min-name tie-break, see module doc)
+            m = jnp.max(masked, axis=-1, keepdims=True)
+            tied = (masked == m) & mask                       # [C, N]
+            n_tied = jnp.sum(tied, axis=-1)                   # [C]
+            t_idx = sl + jnp.arange(chunk)
+            kth = (t_idx % jnp.maximum(n_tied, 1)).astype(jnp.int32)
+            csum = jnp.cumsum(tied.astype(jnp.int32), axis=-1)
+            best = jnp.argmax(tied & (csum == (kth + 1)[:, None]), axis=-1).astype(jnp.int32)
+            feasible = jnp.any(mask, axis=-1)
+            return jnp.where(feasible, best, -1)
+
+        # late rounds have few live tasks: skip the (chunk x N) sweep for
+        # chunks whose tasks are all placed/retired (XLA conditional executes
+        # one branch only, so a dead chunk costs O(chunk) not O(chunk x N))
+        return lax.cond(jnp.any(act), sweep,
+                        lambda _: jnp.full((chunk,), -1, jnp.int32), None)
 
     chunks = lax.map(one_chunk, jnp.arange(n_chunks))
     return chunks.reshape(t_total)
@@ -224,6 +232,23 @@ def _queue_budget(enc, queue_alloc, accept, task_rank, task_queue, task_job):
     return jnp.zeros(t_total, bool).at[order].set(accept_s)
 
 
+@functools.partial(jax.jit, static_argnames=("spec", "layout"))
+def solve_rounds_packed(spec: SolveSpec, layout, f_buf, i_buf, b_buf):
+    """solve_rounds over dtype-packed inputs.
+
+    The PJRT hop (a tunneled TPU here) pays a fixed RTT per transferred
+    buffer; the encoder emits ~46 arrays, so shipping them individually
+    costs more wall-clock than the solve itself. The solver packs them into
+    one flat buffer per dtype class host-side (solver._pack) and this entry
+    unpacks with static slices — free under XLA fusion."""
+    bufs = {"f": f_buf, "i": i_buf, "b": b_buf}
+    enc = {
+        name: lax.slice_in_dim(bufs[kind], off, off + size).reshape(shape)
+        for name, kind, off, size, shape in layout
+    }
+    return solve_rounds.__wrapped__(spec, enc)
+
+
 @functools.partial(jax.jit, static_argnames=("spec",))
 def solve_rounds(spec: SolveSpec, enc: dict):
     """Batched allocate session. Returns (assign [T] int32 node or -1,
@@ -325,8 +350,12 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         return ~st["dead"] & (st["rounds"] < t_total + j_total + 8)
 
     def outer_body(st):
+        # `any(active)` skips the final no-op confirmation sweep when every
+        # task is already placed — the common full-placement session would
+        # otherwise pay one entire extra (T x N) round to learn "no progress"
         st = lax.while_loop(
-            lambda s: s["progress"] & (s["rounds"] < t_total + j_total + 8),
+            lambda s: s["progress"] & jnp.any(s["active"])
+            & (s["rounds"] < t_total + j_total + 8),
             round_body, st)
         st, _rolled = rollback(st)
         return st

@@ -77,6 +77,31 @@ def pad_encoded(enc: EncodedSnapshot, node_multiple: int = 1) -> Dict[str, np.nd
     return a
 
 
+def _pack(arrays: Dict[str, np.ndarray]):
+    """Pack the encoder's ~46 arrays into one flat buffer per dtype class
+    (float / int32 / bool). The PJRT transfer path pays a fixed round-trip
+    per buffer — on a tunneled device that fixed cost dwarfs the bytes — so
+    3 transfers beat 46 by hundreds of ms. Returns (layout, bufs) where
+    layout is the static tuple consumed by rounds.solve_rounds_packed."""
+    parts: Dict[str, list] = {"f": [], "i": [], "b": []}
+    offsets = {"f": 0, "i": 0, "b": 0}
+    layout = []
+    for name in sorted(arrays):
+        v = np.asarray(arrays[name])
+        kind = "f" if v.dtype.kind == "f" else ("b" if v.dtype == np.bool_ else "i")
+        flat = v.ravel()
+        layout.append((name, kind, offsets[kind], flat.size, v.shape))
+        parts[kind].append(flat)
+        offsets[kind] += flat.size
+    float_dtype = np.result_type(*[p.dtype for p in parts["f"]]) if parts["f"] else np.float32
+    bufs = {
+        "f": np.concatenate(parts["f"]).astype(float_dtype) if parts["f"] else np.zeros(0, np.float32),
+        "i": np.concatenate(parts["i"]).astype(np.int32) if parts["i"] else np.zeros(0, np.int32),
+        "b": np.concatenate(parts["b"]) if parts["b"] else np.zeros(0, bool),
+    }
+    return tuple(layout), bufs
+
+
 class BatchAllocator:
     """Callable attached to the session as ``ssn.batch_allocator``.
 
@@ -88,7 +113,11 @@ class BatchAllocator:
         serial loop (one device step per task — latency grows with T);
       - "rounds": the bulk-synchronous throughput kernel (ops/rounds.py),
         gang/feasibility/fair-share preserving but round-granular ordering;
-      - "auto" (default): rounds when tasks >= auto_rounds_threshold.
+      - "auto" (default): rounds when tasks >= AUTO_ROUNDS_THRESHOLD, else
+        the serial host loop (returns False). Below the threshold the
+        serial loop beats any device dispatch — the PJRT hop costs more
+        than scoring a few hundred tasks on host — and the parity scan's
+        per-task device steps are strictly for oracle testing.
     """
 
     AUTO_ROUNDS_THRESHOLD = 2048
@@ -146,7 +175,12 @@ class BatchAllocator:
 
         mode = self.mode
         if mode == "auto":
-            mode = "rounds" if t >= self.AUTO_ROUNDS_THRESHOLD else "parity"
+            if t < self.AUTO_ROUNDS_THRESHOLD:
+                self.profile["fallback"] = (
+                    f"auto: {t} tasks below rounds threshold; serial loop "
+                    f"is cheaper than a device hop")
+                return False
+            mode = "rounds"
 
         try:
             node_multiple = 1
@@ -160,7 +194,19 @@ class BatchAllocator:
             if mode == "rounds":
                 from volcano_tpu.ops import rounds as rounds_mod
 
-                assign, n_rounds = rounds_mod.solve_rounds(enc.spec, arrays)
+                if self.mesh is None:
+                    # single buffer per dtype: 3 host->device transfers
+                    # instead of ~46 (each pays a fixed tunnel RTT)
+                    layout, bufs = _pack(arrays)
+                    tp = time.perf_counter()
+                    assign, n_rounds = rounds_mod.solve_rounds_packed(
+                        enc.spec, layout, bufs["f"], bufs["i"], bufs["b"])
+                    self.profile["pack_s"] = tp - t1
+                    self.profile["dispatch_s"] = time.perf_counter() - tp
+                else:
+                    # mesh path keeps per-array puts: node-axis arrays carry
+                    # NamedShardings that packing would destroy
+                    assign, n_rounds = rounds_mod.solve_rounds(enc.spec, arrays)
                 assign = np.asarray(assign)
                 self.profile["rounds"] = int(n_rounds)
             else:
@@ -238,145 +284,255 @@ class BatchAllocator:
     def _apply_bulk(self, ssn, enc: EncodedSnapshot, assign: np.ndarray) -> None:
         """Bulk writeback for rounds mode: same end state as the statement
         path (session + cache task/node/job status, binder calls, plugin
-        shares) but with node and plugin resource accounting vectorized —
-        per-task work is reduced to the status moves and binder call.
+        shares) but with all resource accounting vectorized and the
+        remaining per-task work reduced to attribute writes + dict moves.
 
         The statement path costs ~40us/task in event handlers, epsilon
         asserts, and per-task Resource arithmetic; at 50k tasks that is the
-        session bottleneck, not the device solve."""
+        session bottleneck, not the device solve. Here each placement costs
+        ~2us: status/node_name on the session + cache task, the index-bucket
+        move on both JobInfos, one shared status-frozen clone into both node
+        task-maps, and the batch binder/event entries."""
         from volcano_tpu.api.resource import Resource
         from volcano_tpu.api.types import TaskStatus
         from volcano_tpu.api.unschedule_info import FitErrors
+        from volcano_tpu.scheduler.cache.interface import BindManyError
 
         a = enc.arrays
         t_real = len(enc.task_infos)
         assign = assign[:t_real]
         placed_mask = assign >= 0
 
-        # --- per-node resource deltas via segment sums --------------------
+        # --- vectorized per-node / per-job resource deltas ----------------
         node_ids = assign[placed_mask]
         reqs = a["task_req"][:t_real][placed_mask]
         n_count = len(enc.node_names)
+        j_count = len(enc.job_infos)
         sums = np.zeros((n_count, reqs.shape[1]))
         np.add.at(sums, node_ids, reqs)
         counts = np.bincount(node_ids, minlength=n_count)
+        job_ids = a["task_job"][:t_real][placed_mask]
+        job_sums = np.zeros((j_count, reqs.shape[1]))
+        np.add.at(job_sums, job_ids, reqs)
+        job_placed_n = np.bincount(job_ids, minlength=j_count)
 
         # resource dim names recovered from the encoder's layout
         scalar_names = enc.resource_names[2:]
 
         def apply_delta(res: Resource, vec, sign: float) -> None:
-            res.milli_cpu += sign * float(vec[0])
-            res.memory += sign * float(vec[1])
+            res.milli_cpu += sign * vec[0]
+            res.memory += sign * vec[1]
             for si, name in enumerate(scalar_names):
-                q = float(vec[2 + si])
+                q = vec[2 + si]
                 if q:
                     res.add_scalar(name, sign * q)
 
-        placed_idx = np.nonzero(placed_mask)[0]
-        by_job: Dict[int, list] = {}
-        for ti in placed_idx:
-            by_job.setdefault(int(a["task_job"][ti]), []).append(int(ti))
-
+        BINDING = TaskStatus.BINDING
+        PENDING = TaskStatus.PENDING
+        task_infos = enc.task_infos
+        job_infos = enc.job_infos
+        node_names = enc.node_names
         cache = ssn.cache
+        ssn_nodes = ssn.nodes
+        cache_nodes = cache.nodes
+        vb = cache.volume_binder
+        vols_noop = getattr(vb, "IS_NOOP", False)
+        alloc_vols = vb.allocate_volumes
+        bind_vols = vb.bind_volumes
+
+        assign_l = assign.tolist()
+        placed_l = np.nonzero(placed_mask)[0].tolist()
+        job_nz = np.nonzero(job_placed_n)[0]
+        seg_ends = np.cumsum(job_placed_n[job_nz]).tolist()
+        job_nz = job_nz.tolist()
+        job_sums_l = job_sums.tolist()
+
+        # tasks are contiguous per job on the flat axis, so placed_l visits
+        # each job's placements as one contiguous run. The loop allocates
+        # ~1 object + a few dict entries per task; suppress the cyclic GC so
+        # gen-promotion scans of the (multi-million-object) session heap
+        # don't fire mid-apply.
+        import gc
+
+        gc_was = gc.isenabled()
+        gc.disable()
         bind_batch = []
-        for ji, tis in by_job.items():
-            job = enc.job_infos[ji]
-            cache_job = cache.jobs.get(job.uid)
-            for ti in tis:
-                task = enc.task_infos[ti]
-                host = enc.node_names[int(assign[ti])]
-                task.node_name = host
-                job.update_task_status(task, TaskStatus.BINDING)
-                # one BINDING-status clone shared by the session and cache
-                # node maps — both trees only read it for accounting and
-                # predicate checks, and it is never status-flipped in place
-                clone = task.clone()
-                ssn.nodes[host].tasks[_task_key(task)] = clone
-                if cache_job is not None:
-                    ctask = cache_job.tasks.get(task.uid)
-                    if ctask is not None:
-                        ctask.node_name = host
-                        cache_job.update_task_status(ctask, TaskStatus.BINDING)
-                        cnode = cache.nodes.get(host)
-                        if cnode is not None:
-                            cnode.tasks[_task_key(ctask)] = clone
-                # effector contract matches session.dispatch -> cache.bind
-                # (cache.py:372-393): volumes first, then the binder
-                cache.allocate_volumes(task, host)
-                cache.bind_volumes(task)
-                bind_batch.append((task, host))
-        binder = cache.binder
         try:
-            if hasattr(binder, "bind_many"):
+            lo = 0
+            for ji, hi in zip(job_nz, seg_ends):
+                tis = placed_l[lo:hi]
+                lo = hi
+                job = job_infos[ji]
+                cache_job = cache.jobs.get(job.uid)
+                idx = job.task_status_index
+                s_pending = idx.get(PENDING)
+                # wholesale bucket move when the whole PENDING set placed
+                # (the common all-or-nothing gang case): O(1) instead of
+                # per-task pop+insert
+                if s_pending is not None and len(s_pending) == len(tis):
+                    s_binding = idx.get(BINDING)
+                    if s_binding is None:
+                        idx[BINDING] = s_pending
+                    else:
+                        s_binding.update(s_pending)
+                    del idx[PENDING]
+                    s_pending = None
+                    s_binding = idx[BINDING]
+                else:
+                    s_binding = idx.get(BINDING)
+                    if s_binding is None:
+                        s_binding = idx[BINDING] = {}
+                if cache_job is not None:
+                    c_tasks = cache_job.tasks
+                    cidx = cache_job.task_status_index
+                    c_pending = cidx.get(PENDING)
+                    if c_pending is not None and len(c_pending) == len(tis):
+                        c_binding = cidx.get(BINDING)
+                        if c_binding is None:
+                            cidx[BINDING] = c_pending
+                        else:
+                            c_binding.update(c_pending)
+                        del cidx[PENDING]
+                        c_pending = None
+                        c_binding = cidx[BINDING]
+                    else:
+                        c_binding = cidx.get(BINDING)
+                        if c_binding is None:
+                            c_binding = cidx[BINDING] = {}
+                else:
+                    c_tasks = c_pending = c_binding = None
+
+                for ti in tis:
+                    task = task_infos[ti]
+                    host = node_names[assign_l[ti]]
+                    task.node_name = host
+                    task.status = BINDING
+                    uid = task.uid
+                    if s_pending is not None:
+                        s_pending.pop(uid, None)
+                        s_binding[uid] = task
+                    # one BINDING-status clone shared by the session and
+                    # cache node maps — both trees only read it for
+                    # accounting and predicate checks, and it is never
+                    # status-flipped in place
+                    clone = task.shared_clone()
+                    key = task.namespace + "/" + task.name
+                    ssn_nodes[host].tasks[key] = clone
+                    if c_tasks is not None:
+                        ctask = c_tasks.get(uid)
+                        if ctask is not None:
+                            ctask.node_name = host
+                            ctask.status = BINDING
+                            if c_pending is not None:
+                                c_pending.pop(uid, None)
+                                c_binding[uid] = ctask
+                            cnode = cache_nodes.get(host)
+                            if cnode is not None:
+                                cnode.tasks[key] = clone
+                    # effector contract matches session.dispatch ->
+                    # cache.bind (cache.py:374-395): volumes, then binder
+                    if not vols_noop:
+                        alloc_vols(task, host)
+                        bind_vols(task)
+                    bind_batch.append((task, host))
+
+                # PENDING -> BINDING leaves total_request unchanged;
+                # allocated grows by the job's placed sum
+                vec = job_sums_l[ji]
+                apply_delta(job.allocated, vec, +1.0)
+                if cache_job is not None:
+                    apply_delta(cache_job.allocated, vec, +1.0)
+        finally:
+            if gc_was:
+                gc.enable()
+
+        # --- batch binder + events ----------------------------------------
+        binder = cache.binder
+        retry_from = None
+        if hasattr(binder, "bind_many"):
+            try:
                 binder.bind_many([(t.pod, h) for t, h in bind_batch])
-            else:
-                for task, host in bind_batch:
-                    binder.bind(task.pod, host)
-        except Exception:
-            # per-task retry so one bad pod degrades to resync, not a lost
+            except BindManyError as e:
+                retry_from = e.done
+            except Exception:
+                # bind_many contract: partial progress => BindManyError; a
+                # bare exception means nothing was bound
+                retry_from = 0
+        else:
+            retry_from = 0
+        if retry_from is not None:
+            # per-task so one bad pod degrades to resync, not a lost
             # session (cache.go:597-599 semantics)
-            for task, host in bind_batch:
+            for task, host in bind_batch[retry_from:]:
                 try:
                     binder.bind(task.pod, host)
                 except Exception:
                     cache.resync_task(task)
         if cache.store is not None:
-            for task, host in bind_batch:
-                cache.store.record_event(
-                    task.pod, "Normal", "Scheduled",
-                    f"Successfully assigned "
-                    f"{task.namespace}/{task.name} to {host}",
-                )
+            cache.store.record_events(
+                (task.pod, "Normal", "Scheduled",
+                 f"Successfully assigned "
+                 f"{task.namespace}/{task.name} to {host}")
+                for task, host in bind_batch)
 
         # --- bulk node accounting (session + cache trees) -----------------
-        for ni, name in enumerate(enc.node_names):
-            if not counts[ni]:
-                continue
-            for node in (ssn.nodes.get(name), cache.nodes.get(name)):
+        sums_l = sums.tolist()
+        for ni in np.nonzero(counts)[0].tolist():
+            vec = sums_l[ni]
+            name = node_names[ni]
+            for node in (ssn_nodes.get(name), cache_nodes.get(name)):
                 if node is None:
                     continue
-                apply_delta(node.idle, sums[ni], -1.0)
-                apply_delta(node.used, sums[ni], +1.0)
+                apply_delta(node.idle, vec, -1.0)
+                apply_delta(node.used, vec, +1.0)
 
         # --- bulk plugin share updates (drf / proportion) -----------------
-        job_sums = np.zeros((len(enc.job_infos), reqs.shape[1]))
-        np.add.at(job_sums, a["task_job"][:t_real][placed_mask], reqs)
+        # per-job DRF shares must be exact per job; namespace/queue shares
+        # aggregate across jobs, so accumulate the deltas in numpy and touch
+        # each namespace/queue attr once
         drf = ssn.plugins.get("drf")
         prop = ssn.plugins.get("proportion")
-        for ji, job in enumerate(enc.job_infos):
-            if not job_sums[ji].any():
-                continue
-            if drf is not None:
+        if drf is not None:
+            for ji in job_nz:
+                job = job_infos[ji]
                 attr = drf.job_attrs.get(job.uid)
                 if attr is not None:
-                    apply_delta(attr.allocated, job_sums[ji], +1.0)
+                    apply_delta(attr.allocated, job_sums_l[ji], +1.0)
                     drf._update_share(attr)
-                    ns_opt = drf.namespace_opts.get(job.namespace)
+        if (drf is not None and drf.namespace_opts) or prop is not None:
+            ns_count_enc = int(a["ns_active0"].shape[0])
+            q_count_enc = int(a["queue_deserved"].shape[0])
+            ns_sums = np.zeros((ns_count_enc, job_sums.shape[1]))
+            q_sums = np.zeros((q_count_enc, job_sums.shape[1]))
+            np.add.at(ns_sums, a["job_ns"][job_nz], job_sums[job_nz])
+            np.add.at(q_sums, a["job_queue"][job_nz], job_sums[job_nz])
+            ns_sums_l = ns_sums.tolist()
+            q_sums_l = q_sums.tolist()
+            if drf is not None and drf.namespace_opts:
+                for nsi in np.nonzero(ns_sums.any(axis=1))[0].tolist():
+                    ns_opt = drf.namespace_opts.get(enc.ns_names[nsi])
                     if ns_opt is not None:
-                        apply_delta(ns_opt.allocated, job_sums[ji], +1.0)
+                        apply_delta(ns_opt.allocated, ns_sums_l[nsi], +1.0)
                         drf._update_share(ns_opt)
             if prop is not None:
-                attr = prop.queue_opts.get(job.queue)
-                if attr is not None:
-                    apply_delta(attr.allocated, job_sums[ji], +1.0)
-                    prop._update_share(attr)
+                for qi in np.nonzero(q_sums.any(axis=1))[0].tolist():
+                    attr = prop.queue_opts.get(enc.queue_uids[qi])
+                    if attr is not None:
+                        apply_delta(attr.allocated, q_sums_l[qi], +1.0)
+                        prop._update_share(attr)
 
         # --- fit errors for gangs the solve could not complete ------------
         start, count = a["job_task_start"], a["job_task_count"]
-        for ji, job in enumerate(enc.job_infos):
+        for ji in np.nonzero(job_placed_n < count)[0].tolist():
+            job = job_infos[ji]
             lo, hi = int(start[ji]), int(start[ji]) + int(count[ji])
-            if lo == hi:
+            if lo == hi or job.ready():
                 continue
-            unplaced = [ti for ti in range(lo, hi) if assign[ti] < 0]
-            if unplaced and not job.ready():
-                fe = FitErrors()
-                fe.set_error(
-                    "0/%d nodes are available in the batched "
-                    "feasibility/fit solve" % n_count)
-                job.nodes_fit_errors[enc.task_infos[unplaced[0]].uid] = fe
+            first = lo + int(np.argmax(assign[lo:hi] < 0))
+            fe = FitErrors()
+            fe.set_error(
+                "0/%d nodes are available in the batched "
+                "feasibility/fit solve" % n_count)
+            job.nodes_fit_errors[task_infos[first].uid] = fe
 
 
-def _task_key(task) -> str:
-    from volcano_tpu.api.pod_helpers import pod_key
-
-    return pod_key(task.pod) if task.pod is not None else f"{task.namespace}/{task.name}"

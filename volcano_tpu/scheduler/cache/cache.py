@@ -26,6 +26,7 @@ from volcano_tpu.api.node_info import NodeInfo
 from volcano_tpu.api.queue_info import QueueInfo
 from volcano_tpu.api.types import TaskStatus
 from volcano_tpu.api.unschedule_info import ALL_NODE_UNAVAILABLE
+from volcano_tpu.scheduler.cache.interface import BindManyError
 from volcano_tpu.store import NotFoundError, Store, WatchHandler
 
 
@@ -51,6 +52,17 @@ class DefaultBinder:
     def bind(self, pod: objects.Pod, hostname: str) -> None:
         pod.spec.node_name = hostname
         self.store.update(pod)
+
+    def bind_many(self, pairs) -> None:
+        """Batch bind; reports partial progress so a mid-batch failure only
+        retries the unbound remainder (interface.BindManyError contract)."""
+        done = 0
+        try:
+            for pod, hostname in pairs:
+                self.bind(pod, hostname)
+                done += 1
+        except Exception as e:
+            raise BindManyError(done, e) from e
 
 
 class DefaultEvictor:

@@ -12,10 +12,29 @@ from __future__ import annotations
 from typing import Protocol, runtime_checkable
 
 
+class BindManyError(Exception):
+    """Raised by a Binder's optional ``bind_many`` on partial failure.
+
+    ``done`` is the count of leading pairs successfully bound before the
+    failure, so the caller retries only the remainder instead of re-binding
+    pods that already succeeded (which would fail against a real binder and
+    spuriously resync genuinely-bound tasks). A bind_many implementation
+    that raises anything else promises it made no partial progress."""
+
+    def __init__(self, done: int, cause: Exception):
+        super().__init__(f"bind_many failed after {done} binds: {cause}")
+        self.done = done
+        self.cause = cause
+
+
 @runtime_checkable
 class Binder(Protocol):
     def bind(self, pod, hostname: str) -> None:
-        """Commit a placement (the pods/{name}/binding POST analog)."""
+        """Commit a placement (the pods/{name}/binding POST analog).
+
+        Implementations may also provide ``bind_many(pairs)`` taking an
+        iterable of (pod, hostname); it must raise BindManyError to report
+        partial progress."""
 
 
 @runtime_checkable

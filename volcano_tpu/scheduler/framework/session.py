@@ -60,6 +60,8 @@ class Session:
         self.job_valid_fns: Dict[str, Callable] = {}
         self.job_enqueueable_fns: Dict[str, Callable] = {}
 
+        self._tier_fns_cache: Dict[tuple, List[List[Callable]]] = {}
+
     # ------------------------------------------------------------------
     # registration (session_plugins.go:26-104)
     # ------------------------------------------------------------------
@@ -127,7 +129,17 @@ class Session:
     # ------------------------------------------------------------------
 
     def _tier_plugins(self, flag_name: Optional[str], fns: Dict[str, Callable]):
-        """Yield (tier, enabled fns in tier order)."""
+        """Enabled fns per tier, in tier order.
+
+        Memoized per (registry, size): dispatch runs per job/task in the
+        hot loops while registration only ever ADDS fns during
+        on_session_open, so a registry's materialized tier lists are valid
+        until its length changes."""
+        key = (flag_name, id(fns), len(fns))
+        cached = self._tier_fns_cache.get(key)
+        if cached is not None:
+            return cached
+        tiers = []
         for tier in self.tiers:
             out = []
             for plugin in tier.plugins:
@@ -136,7 +148,9 @@ class Session:
                 fn = fns.get(plugin.name)
                 if fn is not None:
                     out.append(fn)
-            yield out
+            tiers.append(out)
+        self._tier_fns_cache[key] = tiers
+        return tiers
 
     def _victims(self, flag_name: str, fns, claimer, claimees) -> List[TaskInfo]:
         """Within-tier intersection; first deciding tier wins

@@ -126,11 +126,13 @@ class FakeBinder:
 
     def bind_many(self, pairs) -> None:
         """Batch bind under one lock acquisition (bulk-apply fast path)."""
+        keyed = [
+            (f"{pod.metadata.namespace}/{pod.metadata.name}", hostname)
+            for pod, hostname in pairs
+        ]
         with self._cond:
-            for pod, hostname in pairs:
-                key = f"{pod.metadata.namespace}/{pod.metadata.name}"
-                self.binds[key] = hostname
-                self.channel.append(key)
+            self.binds.update(keyed)
+            self.channel.extend(k for k, _ in keyed)
             self._cond.notify_all()
 
     def wait_for_binds(self, n: int, timeout: float = 5.0) -> bool:
@@ -165,7 +167,10 @@ class FakeStatusUpdater:
 
 
 class FakeVolumeBinder:
-    """No-op volume binder (test_utils.go:154-165)."""
+    """No-op volume binder (test_utils.go:154-165). IS_NOOP lets the bulk
+    apply path skip 2 calls per placement."""
+
+    IS_NOOP = True
 
     def allocate_volumes(self, task, hostname: str) -> None:
         pass

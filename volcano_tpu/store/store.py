@@ -232,6 +232,22 @@ class Store:
                 )
             )
 
+    def record_events(self, items) -> None:
+        """Bulk event record: one lock acquisition for an iterable of
+        (obj, event_type, reason, message) — the bulk-apply path records
+        one Scheduled event per placement (cache.go:601-611)."""
+        with self._lock:
+            self.events.extend(
+                RecordedEvent(
+                    object_kind=type(obj).KIND,
+                    object_key=object_key(obj),
+                    event_type=event_type,
+                    reason=reason,
+                    message=message,
+                )
+                for obj, event_type, reason, message in items
+            )
+
     def events_for(self, obj) -> List[RecordedEvent]:
         key = object_key(obj)
         kind = type(obj).KIND

@@ -168,3 +168,124 @@ class TestRounds:
         total = sum(by_queue.values())
         assert total > 0
         assert abs(by_queue["q-a"] - by_queue["q-b"]) <= 4, by_queue
+
+
+class TestInt32OverflowExactness:
+    """Regression: per-segment cumulative request sums can exceed 2^31
+    quantized units (e.g. 50k tasks x 64-core requests in one queue
+    segment); a wrapped int32 cumsum went negative and passed the
+    budget/fit comparisons. rounds._seg_limbs keeps the sums exact as
+    two 15-bit limbs."""
+
+    def test_queue_budget_exact_past_int32(self):
+        import jax.numpy as jnp
+        from volcano_tpu.ops import rounds as R
+
+        t = 70
+        req = 36_000_000  # 36k cores in milli-cpu: 60 of these wrap int32
+        enc = {
+            "is_scalar": jnp.array([False]),
+            "res_unit": jnp.array([1.0]),
+            "eps": jnp.array([10.0]),
+            "task_req": jnp.full((t, 1), float(req)),
+            "queue_deserved": jnp.array([[2.0e9]]),
+        }
+        accept = jnp.ones(t, bool)
+        task_rank = jnp.arange(t, dtype=jnp.int32)
+        task_queue = jnp.zeros(t, jnp.int32)
+        task_job = jnp.arange(t, dtype=jnp.int32)  # one job per task
+        out = R._queue_budget(enc, jnp.zeros((1, 1)), accept,
+                              task_rank, task_queue, task_job)
+        got = int(jnp.sum(out))
+        # jobs 0..55 see alloc_before = k*36e6 < 2e9 + 10; job 56 is the
+        # first over; a wrapped cumsum would re-admit jobs >= 60
+        assert got == 56, got
+        assert not bool(out[60]), "wrapped cumsum re-admitted job 60"
+
+    def test_resolve_exact_past_int32(self):
+        import jax.numpy as jnp
+        from volcano_tpu.ops import rounds as R
+        from volcano_tpu.ops.kernels import SolveSpec
+
+        t = 70
+        spec = SolveSpec(job_order_keys=("priority",), use_drf_ns_order=False,
+                         use_prop_queue_order=False, use_prop_overused=False,
+                         check_pod_count=False, use_binpack=False,
+                         use_nodeorder=False, max_visits=8)
+        enc = {
+            "is_scalar": jnp.array([False]),
+            "res_unit": jnp.array([1.0]),
+            "eps": jnp.array([10.0]),
+            "task_req": jnp.full((t, 1), 36_000_000.0),
+            "task_has_pod": jnp.zeros(t, bool),
+        }
+        idle = jnp.array([[40_000_000.0]])  # fits exactly one task
+        choice = jnp.zeros(t, jnp.int32)    # everyone picks node 0
+        task_rank = jnp.arange(t, dtype=jnp.int32)
+        accept = R._resolve(spec, enc, idle, jnp.zeros(1, jnp.int32),
+                            choice, task_rank)
+        assert int(jnp.sum(accept)) == 1, int(jnp.sum(accept))
+        assert bool(accept[0])
+
+
+class TestRoundsPluginGate:
+    def test_custom_plugin_forces_serial_fallback(self):
+        """A plugin outside ROUNDS_SAFE_PLUGINS (even one contributing only
+        event handlers, invisible to the encoder's extension-point checks)
+        must not be silently dropped by the statement-free bulk apply."""
+        from volcano_tpu.scheduler.framework import plugins as plugin_registry
+        from volcano_tpu.scheduler.framework.interface import Plugin
+
+        class EventOnlyPlugin(Plugin):
+            def name(self):
+                return "event_only_test"
+
+            def on_session_open(self, ssn):
+                pass
+
+            def on_session_close(self, ssn):
+                pass
+
+        plugin_registry.register_plugin_builder(
+            "event_only_test", lambda args: EventOnlyPlugin())
+        try:
+            def populate(c):
+                c.add_queue(build_queue("default"))
+                c.add_pod_group(build_pod_group("pg0", namespace="ns1",
+                                                min_member=2))
+                for i in range(4):
+                    c.add_pod(build_pod("ns1", f"pg0-p{i}", "",
+                                        objects.POD_PHASE_PENDING,
+                                        {"cpu": "1", "memory": "1Gi"}, "pg0"))
+                c.add_node(build_node(
+                    "node-000", build_resource_list_with_pods("8", "16Gi")))
+
+            cache = make_cache()
+            populate(cache)
+            ssn = open_session(cache, make_tiers(
+                ["tpuscore"], ["priority", "gang", "event_only_test"],
+                arguments=ROUNDS_ARGS))
+            get_action("allocate").execute(ssn)
+            prof = dict(ssn.plugins["tpuscore"].profile)
+            close_session(ssn)
+            assert "fallback" in prof, prof
+            assert "event_only_test" in prof["fallback"], prof
+            # the serial loop still binds everything
+            assert len(cache.binder.binds) == 4
+        finally:
+            plugin_registry._plugin_builders.pop("event_only_test", None)
+
+    def test_seg_limbs_exact_past_lo_limb_wrap(self):
+        """70k rows of 64-core requests: the naive cumsum of even the SPLIT
+        lo limbs wraps int32 (~2.19e9); the carry-normalizing scan must
+        report the exact total."""
+        import jax.numpy as jnp
+        from volcano_tpu.ops import rounds as R
+
+        t = 70_000
+        req = jnp.full((t, 1), 64_000, jnp.int32)
+        start_idx = jnp.zeros(t, jnp.int32)  # one segment
+        hi, lo = R._seg_limbs(req, start_idx)
+        total = int(hi[-1, 0]) * 32768 + int(lo[-1, 0])
+        assert total == 70_000 * 64_000, total
+        assert int(lo[-1, 0]) < 32768

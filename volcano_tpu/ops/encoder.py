@@ -411,6 +411,32 @@ def encode_session(ssn) -> EncodedSnapshot:
     node_idle = _node_matrix("idle")
     node_used = _node_matrix("used")
     node_alloc = _node_matrix("allocatable")
+
+    # int32 bound safety for the rounds kernel: segment accumulators are
+    # limb-exact below 2^46 quantized units (rounds._seg_limbs), but the
+    # quantized BOUNDS (per-node idle, per-queue deserved/allocated — all
+    # <= cluster totals) are plain int32; a cluster whose per-dimension
+    # total exceeds 2^31 quantized units would wrap them, so fall back
+    # honestly instead
+    if node_alloc.size:
+        total_q = node_alloc.sum(axis=0) / res_unit
+        if float(total_q.max()) >= 2.0**31 - 2.0**20:
+            raise EncoderFallback(
+                "cluster capacity exceeds int32 quantized-bound range "
+                f"({total_q.max():.3g} units)")
+    # ... and the limb accumulators sum REQUESTS (accepted or not), so the
+    # total quantized pending request per dimension must stay under their
+    # 2^46 exactness envelope
+    if task_req.size:
+        req_q = np.ceil(task_req / res_unit[None, :])
+        if float(req_q.max()) >= 2.0**31:
+            raise EncoderFallback(
+                "a single task request exceeds int32 quantized range")
+        total_req_q = req_q.sum(axis=0)
+        if float(total_req_q.max()) >= 2.0**46:
+            raise EncoderFallback(
+                "total pending request exceeds the limb-exact cumsum range "
+                f"({total_req_q.max():.3g} units)")
     node_cnt = np.array([len(n.tasks) for n in nodes], np.int32)
     node_max_tasks = np.array([n.allocatable.max_task_num for n in nodes], np.int32)
 

@@ -25,9 +25,12 @@ terminates because each rollback retires exactly one job.
 Documented divergences from the serial oracle (and hence from parity mode):
 scores are computed against round-start state (bulk-synchronous), fair-share
 interleaving is round- rather than visit-grained, overused queues re-enter
-when a rollback drops them below deserved, and the adaptive node-sampling
-window does not apply (every task sees every node — strictly better
-placements than the reference's sampled serial loop).
+when a rollback drops them below deserved, weighted-DRF NAMESPACE ordering
+is not applied to the job rank (_job_rank keys on tie-rank/priority/gang/
+drf-share only; ns_alloc is tracked in state but does not reorder jobs —
+namespace fairness under contention is round-granular at best), and the
+adaptive node-sampling window does not apply (every task sees every node —
+strictly better placements than the reference's sampled serial loop).
 
 Invariants preserved (asserted by tests/test_rounds.py): every placement is
 feasible per the predicate mask and epsilon arithmetic, no node exceeds idle
@@ -130,17 +133,59 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
     return chunks.reshape(t_total)
 
 
+def _seg_limbs(req_s, start_idx):
+    """Segment-inclusive cumulative sums of int32 requests as two 15-bit
+    limbs (hi, lo with lo < 2^15), exact for totals below 2^46.
+
+    A single int32 cumsum over the flat task axis can wrap: 50k tasks of
+    64-core requests put >2^31 milli-cpu in one segment, and a wrapped sum
+    goes negative and passes the 'seg < bound' fit check — over-allocating
+    the node. Naive cumsums of the SPLIT limbs wrap too (the lo-limb sum
+    alone reaches 2^31 after ~2^16 max-size rows), so the prefix sums are
+    built with a carry-normalizing associative scan: every partial keeps
+    lo in [0, 2^15), and hi holds total>>15 — within int32 for any prefix
+    total < 2^46 (70 billion cores / 64 EiB; the encoder gates totals far
+    below that)."""
+
+    def combine(a, b):
+        ah, al = a
+        bh, bl = b
+        l = al + bl
+        return ah + bh + (l >> 15), l & 0x7FFF
+
+    chi, clo = lax.associative_scan(
+        combine, (req_s >> 15, req_s & 0x7FFF), axis=0)
+    prev = jnp.maximum(start_idx - 1, 0)
+    has_base = (start_idx > 0)[:, None]
+    base_hi = jnp.where(has_base, chi[prev], 0)
+    base_lo = jnp.where(has_base, clo[prev], 0)
+    # limb-wise subtraction with borrow: prefix pairs are normalized, so
+    # dl in (-2^15, 2^15) and dh <= chi — no intermediate overflow
+    dl = clo - base_lo
+    dh = chi - base_hi
+    borrow = (dl < 0).astype(jnp.int32)
+    return dh - borrow, dl + (borrow << 15)
+
+
+def _limbs_lt(seg_hi, seg_lo, bound):
+    """Exact (seg_hi*2^15 + seg_lo) < bound for non-negative limb pairs;
+    bounds <= 0 compare false (nothing non-negative is below them)."""
+    b = jnp.maximum(bound, 0)
+    b_hi = b >> 15
+    b_lo = b & 0x7FFF
+    return (seg_hi < b_hi) | ((seg_hi == b_hi) & (seg_lo < b_lo))
+
+
 def _resolve(spec: SolveSpec, enc, idle, cnt, choice, task_rank):
     """Per-node prefix acceptance: sort by (node, rank), accept the longest
     priority-prefix whose cumulative request fits. Returns accept [T] bool."""
     t_total = choice.shape[0]
-    eps = enc["eps"]
     has_pod = enc["task_has_pod"]
     # conservative integer units (milli-cpu / MiB / milli-scalar): a float32
     # running cumsum over 50k tasks drifts past the 10 MiB memory epsilon at
-    # ~1e14-byte magnitudes; int32 in these units is exact (headline totals
-    # ~1e8 << 2^31) and the ceil(req)/floor(idle) pairing can only
-    # under-place by <1 unit, never over-allocate
+    # ~1e14-byte magnitudes; two-limb int32 in these units is exact for any
+    # aggregate (see _seg_limbs) and the ceil(req)/floor(idle) pairing can
+    # only under-place by <1 unit, never over-allocate
     req_i = jnp.ceil(enc["task_req"] / enc["res_unit"][None, :]).astype(jnp.int32)
     idle_i = jnp.floor(idle / enc["res_unit"][None, :]).astype(jnp.int32)
     eps_i = (enc["eps"] / enc["res_unit"]).astype(jnp.int32)
@@ -157,14 +202,12 @@ def _resolve(spec: SolveSpec, enc, idle, cnt, choice, task_rank):
     seg_start = jnp.concatenate([jnp.ones(1, bool), ch_s[1:] != ch_s[:-1]])
     idx = jnp.arange(t_total)
     start_idx = lax.cummax(jnp.where(seg_start, idx, 0))
-    c = jnp.cumsum(req_s, axis=0)                             # exact int32
-    base = jnp.where(start_idx[:, None] > 0, c[jnp.maximum(start_idx - 1, 0)], 0)
-    seg_cum = c - base                                        # [T, R] incl. self
+    seg_hi, seg_lo = _seg_limbs(req_s, start_idx)             # [T, R] incl. self
 
     node = jnp.clip(ch_s, 0, idle.shape[0] - 1)
     idle_s = idle_i[node]                                     # [T, R]
     # stepwise-epsilon equivalence: task k fits iff cumsum_k <= idle + eps
-    le = seg_cum < idle_s + eps_i[None, :]
+    le = _limbs_lt(seg_hi, seg_lo, idle_s + eps_i[None, :])
     skip = is_scalar[None, :] & (req_s <= MIN_MILLI_SCALAR)
     fits = jnp.all(le | skip, axis=-1) & (ch_s != jnp.iinfo(jnp.int32).max)
 
@@ -199,7 +242,7 @@ def _queue_budget(enc, queue_alloc, accept, task_rank, task_queue, task_job):
     """
     t_total = accept.shape[0]
     is_scalar = enc["is_scalar"]
-    # same exact-int32 units as _resolve (see the drift note there)
+    # same exact two-limb int32 units as _resolve (see _seg_limbs)
     unit = enc["res_unit"]
     eps_i = (enc["eps"] / unit).astype(jnp.int32)
     req_i = jnp.ceil(enc["task_req"] / unit[None, :]).astype(jnp.int32)
@@ -214,18 +257,29 @@ def _queue_budget(enc, queue_alloc, accept, task_rank, task_queue, task_job):
     q_start = jnp.concatenate([jnp.ones(1, bool), q_s[1:] != q_s[:-1]])
     j_start = q_start | jnp.concatenate([jnp.ones(1, bool), job_s[1:] != job_s[:-1]])
 
-    c = jnp.cumsum(req_s, axis=0)                   # exact int32
+    # exclusive-of-this-job, within-queue cumulative: segment cumsum over
+    # the queue minus the segment cumsum over the job, shifted to the job
+    # start (both limb-exact)
     q_base_idx = lax.cummax(jnp.where(q_start, idx, 0))
     j_base_idx = lax.cummax(jnp.where(j_start, idx, 0))
-    q_base = jnp.where(q_base_idx[:, None] > 0, c[jnp.maximum(q_base_idx - 1, 0)], 0)
-    j_base = jnp.where(j_base_idx[:, None] > 0, c[jnp.maximum(j_base_idx - 1, 0)], 0)
-    queue_cum_before_job = j_base - q_base          # higher-ranked jobs, same queue
+    seg_hi, seg_lo = _seg_limbs(req_s, q_base_idx)  # within-queue incl. self
+    # value at the last position BEFORE my job started: 0 when my job opens
+    # its queue segment, else the within-queue cumsum one row up (that row
+    # is in my queue by construction)
+    job_at_queue_start = q_start[j_base_idx][:, None]
+    prev = jnp.maximum(j_base_idx - 1, 0)
+    before_hi = jnp.where(job_at_queue_start, 0, seg_hi[prev])
+    before_lo = jnp.where(job_at_queue_start, 0, seg_lo[prev])
 
     alloc_i = jnp.ceil(queue_alloc / unit[None, :]).astype(jnp.int32)
     deserved_i = jnp.floor(enc["queue_deserved"] / unit[None, :]).astype(jnp.int32)
-    alloc_before = alloc_i[q_s] + queue_cum_before_job
-    le = alloc_before < deserved_i[q_s] + eps_i[None, :]
-    skip = is_scalar[None, :] & (alloc_before <= MIN_MILLI_SCALAR)
+    # total = queue_alloc + higher-ranked same-queue jobs, as limbs
+    a = alloc_i[q_s]
+    tot_lo = before_lo + (a & 0x7FFF)
+    tot_hi = before_hi + (a >> 15) + (tot_lo >> 15)
+    tot_lo = tot_lo & 0x7FFF
+    le = _limbs_lt(tot_hi, tot_lo, deserved_i[q_s] + eps_i[None, :])
+    skip = is_scalar[None, :] & (tot_hi == 0) & (tot_lo <= MIN_MILLI_SCALAR)
     ok = jnp.all(le | skip, axis=-1)
 
     accept_s = accept[order] & ok

@@ -43,6 +43,14 @@ def _pad_axis(a: np.ndarray, axis: int, size: int, fill=0):
     return np.pad(a, widths, constant_values=fill)
 
 
+# plugins whose allocate-time effects the bulk writeback reproduces exactly
+# (statement-free share/accounting updates in _apply_bulk); anything else in
+# the conf forces the serial loop when rounds mode would otherwise run
+ROUNDS_SAFE_PLUGINS = frozenset({
+    "tpuscore", "priority", "gang", "drf", "proportion",
+    "predicates", "nodeorder", "binpack", "conformance",
+})
+
 _NODE_AXIS = {
     "sig_mask": 1, "affinity_score": 1,
     "node_idle": 0, "node_used": 0, "node_alloc": 0,
@@ -162,6 +170,23 @@ class BatchAllocator:
         from volcano_tpu.scheduler.util import scheduler_helper
 
         t0 = time.perf_counter()
+        if self.mode in ("rounds", "auto"):
+            # the bulk writeback (_apply_bulk) bypasses the Statement event
+            # machinery and hardcodes drf/proportion share updates; a
+            # custom plugin registered through the public seam — even one
+            # that only adds event handlers or allocatable fns, which the
+            # encoder's extension-point checks cannot see — would silently
+            # lose its allocate-event effects. Gate on plugin names BEFORE
+            # paying the encode cost (in auto mode unknown plugins make
+            # rounds unreachable regardless of the task-count threshold,
+            # and sub-threshold sessions go serial anyway).
+            unknown = {
+                p.name for tier in ssn.tiers for p in tier.plugins
+            } - ROUNDS_SAFE_PLUGINS
+            if unknown:
+                self.profile["fallback"] = (
+                    f"rounds apply cannot honor custom plugins: {sorted(unknown)}")
+                return False
         try:
             enc = encode_session(ssn)
         except EncoderFallback as e:

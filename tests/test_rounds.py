@@ -289,3 +289,176 @@ class TestRoundsPluginGate:
         total = int(hi[-1, 0]) * 32768 + int(lo[-1, 0])
         assert total == 70_000 * 64_000, total
         assert int(lo[-1, 0]) < 32768
+
+
+class TestRoundsResidue:
+    """The EncoderFallback cliff is gone in rounds mode: un-modeled
+    constructs degrade to a per-task serial residue pass (or host-side
+    masks), never a whole-session serial outage."""
+
+    def _affinity(self, labels):
+        return objects.Affinity(
+            pod_anti_affinity=objects.PodAntiAffinity(required_terms=[
+                objects.PodAffinityTerm(
+                    label_selector=objects.LabelSelector(match_labels=labels),
+                    topology_key="kubernetes.io/hostname",
+                )
+            ])
+        )
+
+    def test_affinity_task_as_residue(self):
+        """One anti-affinity pod among plain gangs: bulk solves the gangs,
+        the serial pass places the affinity pod — no session fallback."""
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            for g in range(6):
+                pg = f"pg{g}"
+                c.add_pod_group(build_pod_group(pg, namespace="ns1", min_member=2))
+                for i in range(2):
+                    c.add_pod(build_pod("ns1", f"{pg}-p{i}", "",
+                                        objects.POD_PHASE_PENDING,
+                                        {"cpu": "1", "memory": "1Gi"}, pg))
+            c.add_pod_group(build_pod_group("pga", namespace="ns1", min_member=1))
+            pod = build_pod("ns1", "pga-p0", "", objects.POD_PHASE_PENDING,
+                            {"cpu": "1", "memory": "1Gi"}, "pga",
+                            labels={"app": "solo"})
+            pod.spec.affinity = self._affinity({"app": "solo"})
+            c.add_pod(pod)
+            for n in range(4):
+                c.add_node(build_node(
+                    f"node-{n:03d}", build_resource_list_with_pods("8", "16Gi")))
+
+        cache, prof = run_rounds(populate)
+        assert prof.get("residue") == 1, prof
+        assert len(cache.binder.binds) == 13  # 12 gang + 1 residue
+        assert "ns1/pga-p0" in cache.binder.binds
+
+    def test_host_port_tasks_as_residue(self):
+        """Two pods wanting the same host port land on different nodes via
+        the serial residue pass."""
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            for k in range(2):
+                pg = f"pgp{k}"
+                c.add_pod_group(build_pod_group(pg, namespace="ns1", min_member=1))
+                pod = build_pod("ns1", f"{pg}-p0", "", objects.POD_PHASE_PENDING,
+                                {"cpu": "1", "memory": "1Gi"}, pg)
+                pod.spec.containers[0].ports = [
+                    objects.ContainerPort(host_port=8080)]
+                c.add_pod(pod)
+            # filler gang so the bulk solve has work
+            c.add_pod_group(build_pod_group("pgf", namespace="ns1", min_member=2))
+            for i in range(2):
+                c.add_pod(build_pod("ns1", f"pgf-p{i}", "",
+                                    objects.POD_PHASE_PENDING,
+                                    {"cpu": "1", "memory": "1Gi"}, "pgf"))
+            for n in range(2):
+                c.add_node(build_node(
+                    f"node-{n:03d}", build_resource_list_with_pods("8", "16Gi")))
+
+        cache, prof = run_rounds(populate)
+        assert prof.get("residue") == 2, prof
+        binds = cache.binder.binds
+        assert len(binds) == 4, binds
+        assert binds["ns1/pgp0-p0"] != binds["ns1/pgp1-p0"], binds
+
+    def test_existing_anti_affinity_symmetry_masks_bulk(self):
+        """An existing pod's required anti-affinity bars matching bulk pods
+        from its node (host-precomputed signature mask, not fallback)."""
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            # existing running pod with anti-affinity against app=web
+            c.add_pod_group(build_pod_group("pge", namespace="ns1", min_member=1))
+            epod = build_pod("ns1", "pge-p0", "node-000", objects.POD_PHASE_RUNNING,
+                             {"cpu": "1", "memory": "1Gi"}, "pge",
+                             labels={"app": "guard"})
+            epod.spec.affinity = self._affinity({"app": "web"})
+            c.add_pod(epod)
+            # plain bulk pods labeled app=web
+            c.add_pod_group(build_pod_group("pgw", namespace="ns1", min_member=2))
+            for i in range(2):
+                c.add_pod(build_pod("ns1", f"pgw-p{i}", "",
+                                    objects.POD_PHASE_PENDING,
+                                    {"cpu": "1", "memory": "1Gi"}, "pgw",
+                                    labels={"app": "web"}))
+            for n in range(3):
+                c.add_node(build_node(
+                    f"node-{n:03d}", build_resource_list_with_pods("8", "16Gi")))
+
+        cache, prof = run_rounds(populate)
+        binds = cache.binder.binds
+        assert len(binds) == 2, binds
+        assert all(v != "node-000" for v in binds.values()), binds
+
+    def test_releasing_capacity_pipelines_leftovers(self):
+        """A draining node no longer aborts encoding: bulk places what idle
+        allows and the serial pass pipelines the leftover onto releasing
+        capacity (committed because the job reaches ready via its
+        idle-fitting task, allocate.go:238-242 semantics)."""
+        from volcano_tpu.api.types import TaskStatus
+
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            # node-000 free; node-001 fully used by a terminating pod
+            c.add_node(build_node("node-000",
+                                  build_resource_list_with_pods("4", "8Gi")))
+            c.add_node(build_node("node-001",
+                                  build_resource_list_with_pods("4", "8Gi")))
+            c.add_pod_group(build_pod_group("pgr", namespace="ns1", min_member=1))
+            rpod = build_pod("ns1", "pgr-p0", "node-001", objects.POD_PHASE_RUNNING,
+                             {"cpu": "4", "memory": "8Gi"}, "pgr")
+            rpod.metadata.deletion_timestamp = 1.0
+            c.add_pod(rpod)
+            # 2-task job (min=1): one task fits idle node-000, the other
+            # only fits node-001 once the releasing pod drains
+            c.add_pod_group(build_pod_group("pgn", namespace="ns1", min_member=1))
+            for i in range(2):
+                c.add_pod(build_pod("ns1", f"pgn-p{i}", "",
+                                    objects.POD_PHASE_PENDING,
+                                    {"cpu": "4", "memory": "8Gi"}, "pgn"))
+
+        cache = make_cache()
+        populate(cache)
+        ssn = open_session(
+            cache, make_tiers(["tpuscore"], *DEFAULT_TIERS, arguments=ROUNDS_ARGS))
+        get_action("allocate").execute(ssn)
+        prof = dict(ssn.plugins["tpuscore"].profile)
+        assert prof.get("has_releasing"), prof
+        # one task bound on the idle node; the other pipelined onto the
+        # draining one — pipelining is session-local (no binder call), so
+        # assert on the session tree before close
+        assert list(cache.binder.binds.values()) == ["node-000"], cache.binder.binds
+        job = ssn.jobs["ns1/pgn"]
+        pip = job.task_status_index.get(TaskStatus.PIPELINED, {})
+        assert len(pip) == 1, dict(job.task_status_index)
+        assert next(iter(pip.values())).node_name == "node-001"
+        close_session(ssn)
+
+    def test_symmetry_distinguishes_labels_within_plain_signature(self):
+        """Two plain pods differing only in labels must get independent
+        symmetry verdicts (signatures alone don't encode labels; the
+        encoder extends keys when symmetry terms are live)."""
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            c.add_pod_group(build_pod_group("pge", namespace="ns1", min_member=1))
+            epod = build_pod("ns1", "pge-p0", "node-000", objects.POD_PHASE_RUNNING,
+                             {"cpu": "1", "memory": "1Gi"}, "pge",
+                             labels={"app": "guard"})
+            epod.spec.affinity = self._affinity({"app": "web"})
+            c.add_pod(epod)
+            # unlabeled plain pod FIRST (becomes the '<plain>' rep without
+            # the key extension), labeled app=web pod second
+            c.add_pod_group(build_pod_group("pgu", namespace="ns1", min_member=1))
+            c.add_pod(build_pod("ns1", "pgu-p0", "", objects.POD_PHASE_PENDING,
+                                {"cpu": "4", "memory": "1Gi"}, "pgu"))
+            c.add_pod_group(build_pod_group("pgw", namespace="ns1", min_member=1))
+            c.add_pod(build_pod("ns1", "pgw-p0", "", objects.POD_PHASE_PENDING,
+                                {"cpu": "4", "memory": "1Gi"}, "pgw",
+                                labels={"app": "web"}))
+            c.add_node(build_node("node-000", build_resource_list_with_pods("9", "16Gi")))
+            c.add_node(build_node("node-001", build_resource_list_with_pods("4", "4Gi")))
+
+        cache, prof = run_rounds(populate)
+        binds = cache.binder.binds
+        assert len(binds) == 2, binds
+        assert binds["ns1/pgw-p0"] == "node-001", binds

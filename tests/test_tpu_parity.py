@@ -309,9 +309,13 @@ class TestTpuParity:
             c.add_pod(pod)
             c.add_node(build_node("n1", build_resource_list_with_pods("4", "8Gi")))
 
+        # parity mode keeps the session-wide fallback (bit-exactness);
+        # rounds mode handles the same construct as serial residue instead
+        # (tests/test_rounds.py TestRoundsResidue)
         cache = make_cache()
         populate(cache)
-        ssn = open_session(cache, make_tiers(["tpuscore"], *DEFAULT_TIERS))
+        ssn = open_session(
+            cache, make_tiers(["tpuscore"], *DEFAULT_TIERS, arguments=PARITY_ARGS))
         get_action("allocate").execute(ssn)
         prof = ssn.plugins["tpuscore"].profile
         assert "fallback" in prof

@@ -91,6 +91,12 @@ class EncodedSnapshot:
     queue_uids: List[str] = field(default_factory=list)
     num_to_find: int = 0
     rr0: int = 0
+    # residue: pending tasks excluded from the device solve (pod affinity /
+    # host ports) — left PENDING for the serial pass that runs after the
+    # bulk apply; job_residue[j] counts them per encoded job
+    residue_count: int = 0
+    job_residue: Optional[np.ndarray] = None
+    has_releasing: bool = False
 
     @property
     def shape(self) -> Tuple[int, ...]:
@@ -192,11 +198,25 @@ def _resource_vec(res: Resource, names: List[str]) -> np.ndarray:
     return np.array([res.get(n) for n in names], np.float64)
 
 
-def encode_session(ssn) -> EncodedSnapshot:
+def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
     """Build the dense solve inputs from a live session.
 
     Raises EncoderFallback when the session cannot be modeled; the allocate
     action then runs its serial loop (the parity oracle).
+
+    With ``allow_residue`` (the rounds path), constructs the kernel does not
+    model stop being session-wide cliffs:
+    - pending tasks with pod (anti-)affinity or host ports are EXCLUDED
+      from the device solve and left PENDING for a serial residue pass
+      (full predicate fidelity at per-task cost);
+    - nodes holding releasing capacity no longer abort encoding — the bulk
+      solve places against idle only (conservative) and the serial pass
+      pipelines leftovers onto releasing capacity;
+    - required anti-affinity terms of EXISTING pods are honored for the
+      bulk tasks through host-precomputed per-signature node masks (the
+      predicates plugin's symmetry rule, predicates.go:281-299); soft
+      (preferred) inter-pod terms only shift nodeorder scores and are a
+      documented rounds-mode divergence.
     """
     from volcano_tpu.scheduler.util import scheduler_helper
 
@@ -233,17 +253,28 @@ def encode_session(ssn) -> EncodedSnapshot:
     node_names = sorted(ssn.nodes)
     nodes = [ssn.nodes[n] for n in node_names]
     n_count = len(nodes)
-    for node in nodes:
+    has_releasing = False
+    sym_terms = []  # (anti-affinity term, owner namespace, node index)
+    for ni, node in enumerate(nodes):
         if not node.releasing.is_empty():
-            raise EncoderFallback("releasing resources (pipeline path) not modeled")
+            if not allow_residue:
+                raise EncoderFallback("releasing resources (pipeline path) not modeled")
+            has_releasing = True
         for t in node.tasks.values():
             if t.pod is None:
                 continue
             _, ports, aff = _pod_encode_traits(t.pod)
-            if aff:
-                raise EncoderFallback("pod (anti-)affinity not modeled")
-            if ports:
+            if ports and not allow_residue:
+                # existing ports only constrain residue tasks, which the
+                # serial pass checks with full fidelity
                 raise EncoderFallback("host ports not modeled")
+            if aff:
+                if not allow_residue:
+                    raise EncoderFallback("pod (anti-)affinity not modeled")
+                affinity = t.pod.spec.affinity
+                if affinity.pod_anti_affinity is not None:
+                    for term in affinity.pod_anti_affinity.required_terms:
+                        sym_terms.append((term, t.pod.metadata.namespace, ni))
 
     # ---- eligible jobs (allocate.go:49-76 filter) --------------------------
     jobs: List[JobInfo] = []
@@ -308,6 +339,14 @@ def encode_session(ssn) -> EncodedSnapshot:
         def sort_pending(pending: List[TaskInfo]) -> None:
             pending.sort(key=cmp_to_key(order_key))
 
+    # with live anti-affinity symmetry terms, mask membership depends on a
+    # pod's labels AND namespace (selector matching) — extend the signature
+    # key so all pods sharing a signature also share symmetry verdicts
+    # (otherwise an unlabeled representative could unmask labeled pods, or
+    # vice versa)
+    sym_active = bool(sym_terms)
+
+    job_residue = np.zeros(j_count, np.int32)
     for ji, job in enumerate(jobs):
         pending = [
             t
@@ -316,22 +355,31 @@ def encode_session(ssn) -> EncodedSnapshot:
         ]
         sort_pending(pending)
         job_task_start[ji] = len(task_infos)
-        job_task_count[ji] = len(pending)
         for t in pending:
             if t.pod is None:
                 key = "<none>"
             else:
                 key, ports, aff = _pod_encode_traits(t.pod)
                 if aff:
-                    raise EncoderFallback("pod (anti-)affinity not modeled")
+                    if not allow_residue:
+                        raise EncoderFallback("pod (anti-)affinity not modeled")
+                    job_residue[ji] += 1
+                    continue
                 if ports:
-                    raise EncoderFallback("host ports not modeled")
+                    if not allow_residue:
+                        raise EncoderFallback("host ports not modeled")
+                    job_residue[ji] += 1
+                    continue
+            if sym_active and t.pod is not None:
+                key = (f"{key}|labels={sorted(t.pod.metadata.labels.items())!r}"
+                       f"|ns={t.pod.metadata.namespace}")
             si = sig_index.get(key)
             if si is None:
                 si = sig_index[key] = len(sig_rep)
                 sig_rep.append(t)
             task_sig.append(si)
             task_infos.append(t)
+        job_task_count[ji] = len(task_infos) - int(job_task_start[ji])
     t_count = len(task_infos)
     s_count = max(len(sig_rep), 1)
 
@@ -381,6 +429,37 @@ def encode_session(ssn) -> EncodedSnapshot:
                 ]
             )
             sig_mask[si] = node_ok & row
+
+        # required anti-affinity SYMMETRY of existing pods: a new pod that
+        # matches an existing pod's anti-affinity selector is barred from
+        # that pod's whole topology domain (predicates.py pod_affinity_fits
+        # symmetry block). Signatures include pod labels+namespace when
+        # symmetry terms are live (see sym_active), so one host check per
+        # (deduped term, signature) covers every bulk task. Terms are
+        # deduped by (selector, namespaces, topology domain) — a
+        # 500-replica anti-affine deployment contributes ONE entry per
+        # domain, not 500.
+        seen_terms = set()
+        domains: Dict[tuple, np.ndarray] = {}
+        for term, owner_ns, ni in sym_terms:
+            topo_v = predicates_mod._node_topology_value(
+                nodes[ni], term.topology_key)
+            dedup = (repr(term.label_selector), tuple(term.namespaces),
+                     owner_ns, term.topology_key, topo_v)
+            if dedup in seen_terms:
+                continue
+            seen_terms.add(dedup)
+            dkey = (term.topology_key, topo_v)
+            domain = domains.get(dkey)
+            if domain is None:
+                domain = domains[dkey] = np.array([
+                    predicates_mod._node_topology_value(n, term.topology_key) == topo_v
+                    for n in nodes
+                ])
+            for si, rep in enumerate(sig_rep):
+                if rep.pod is not None and predicates_mod._selector_matches_pod(
+                        term, rep.pod, owner_ns):
+                    sig_mask[si, domain] = False
 
     # ---- static preferred node-affinity score per signature ----------------
     affinity_score = np.zeros((s_count, n_count), np.float64)
@@ -602,5 +681,8 @@ def encode_session(ssn) -> EncodedSnapshot:
         queue_uids=queue_ids,
         num_to_find=scheduler_helper.calculate_num_of_feasible_nodes_to_find(n_count),
         rr0=scheduler_helper._last_processed_node_index,
+        residue_count=int(job_residue.sum()),
+        job_residue=job_residue,
+        has_releasing=has_releasing,
     )
     return enc

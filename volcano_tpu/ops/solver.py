@@ -188,14 +188,24 @@ class BatchAllocator:
                     f"rounds apply cannot honor custom plugins: {sorted(unknown)}")
                 return False
         try:
-            enc = encode_session(ssn)
+            # rounds mode tolerates un-modeled constructs as a serial
+            # residue (affinity/port tasks stay PENDING; releasing capacity
+            # serves leftovers) — parity mode must stay bit-exact, so it
+            # keeps the session-wide fallback
+            enc = encode_session(
+                ssn, allow_residue=self.mode in ("rounds", "auto"))
         except EncoderFallback as e:
             logger.info("tpuscore falling back to serial allocate: %s", e)
             self.profile["fallback"] = str(e)
             return False
         t, n, j, *_ = enc.shape
         if t == 0 or n == 0 or j == 0:
-            # nothing to place; serial loop is also a no-op but cheaper
+            # nothing for the device to place (possibly everything pending
+            # is residue); the serial loop handles whatever remains
+            if enc.residue_count:
+                self.profile["fallback"] = (
+                    f"all {enc.residue_count} pending tasks are residue "
+                    f"(affinity/ports); serial loop handles them")
             return False
 
         mode = self.mode
@@ -258,6 +268,8 @@ class BatchAllocator:
             encode_s=t1 - t0, solve_s=t2 - t1, apply_s=t3 - t2,
             tasks=t, nodes=n, jobs=j,
             placed=int((assign[: len(enc.task_infos)] >= 0).sum()),
+            residue=enc.residue_count,
+            has_releasing=enc.has_releasing,
         )
         return True
 
@@ -548,10 +560,18 @@ class BatchAllocator:
 
         # --- fit errors for gangs the solve could not complete ------------
         start, count = a["job_task_start"], a["job_task_count"]
+        job_residue = enc.job_residue
         for ji in np.nonzero(job_placed_n < count)[0].tolist():
             job = job_infos[ji]
             lo, hi = int(start[ji]), int(start[ji]) + int(count[ji])
             if lo == hi or job.ready():
+                continue
+            if (job_residue is not None and job_residue[ji]) or enc.has_releasing:
+                # the serial pass retries this job (residue tasks, or
+                # releasing capacity it may pipeline onto) with full
+                # predicate fidelity; it records its own fit errors —
+                # mirror allocate.py's retry condition so no stale
+                # '0/N nodes' error outlives a successful retry
                 continue
             first = lo + int(np.argmax(assign[lo:hi] < 0))
             fe = FitErrors()

@@ -37,6 +37,19 @@ class AllocateAction(Action):
         # serial loop below remains the fallback and oracle.
         solver = getattr(ssn, "batch_allocator", None)
         if solver is not None and solver(ssn):
+            prof = solver.profile
+            residue = prof.get("residue", 0)
+            unplaced = prof.get("tasks", 0) - prof.get("placed", 0)
+            if residue or (prof.get("has_releasing") and unplaced):
+                # serial residue pass: tasks the device solve does not model
+                # (pod affinity, host ports) are still PENDING, and nodes
+                # with releasing capacity can still pipeline leftovers; the
+                # serial loop picks up exactly the remaining pending tasks
+                # on post-bulk state with full predicate fidelity
+                logger.info(
+                    "allocate: serial residue pass (%d residue tasks, "
+                    "%d unplaced)", residue, unplaced)
+                self._serial_execute(ssn)
             return
         self._serial_execute(ssn)
 

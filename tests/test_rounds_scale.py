@@ -1,0 +1,138 @@
+"""Sharded rounds-mode execution + mid-scale serial-vs-rounds quality gate.
+
+VERDICT r1 weak-spot #6: rounds mode previously had no mesh-sharded test
+(the only sharded test ran the parity scan) and no mid-scale comparison
+against the serial oracle in the regime BENCH actually runs. These tests
+close both gaps on the 8-device virtual CPU mesh (conftest).
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tests.helpers import make_cache, make_tiers
+from tests.test_rounds import ROUNDS_ARGS, check_invariants
+from tests.test_tpu_parity import DEFAULT_TIERS
+from volcano_tpu.api import objects
+from volcano_tpu.scheduler.framework import close_session, get_action, open_session
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list_with_pods,
+)
+
+
+def _mixed_cluster(n_groups, group_size, min_member, n_nodes, queues=1, seed=13):
+    """Heterogeneous gangs over queues; capacity-tight but satisfiable."""
+
+    def populate(c):
+        rng = random.Random(seed)
+        for q in range(queues):
+            c.add_queue(build_queue(f"q-{q}", weight=1 + q % 3))
+        for g in range(n_groups):
+            pg = f"pg{g:05d}"
+            c.add_pod_group(build_pod_group(
+                pg, namespace="scale", min_member=min_member,
+                queue=f"q-{g % queues}"))
+            for i in range(group_size):
+                c.add_pod(build_pod(
+                    "scale", f"{pg}-t{i}", "", objects.POD_PHASE_PENDING,
+                    {"cpu": f"{rng.choice([250, 500, 1000])}m",
+                     "memory": rng.choice(["512Mi", "1Gi"])}, pg))
+        for n in range(n_nodes):
+            c.add_node(build_node(
+                f"node-{n:05d}",
+                build_resource_list_with_pods("16", "32Gi", pods=64)))
+
+    return populate
+
+
+class TestShardedRounds:
+    def test_mesh_sharded_rounds_non_divisible_nodes(self):
+        """ROUNDS mode (not the parity scan) on an 8-device mesh with a
+        node count not divisible by the mesh — exercises node-axis padding
+        plus the sharded bulk solve end-to-end."""
+        devs = jax.devices()
+        assert len(devs) >= 8, devs
+        populate = _mixed_cluster(
+            n_groups=40, group_size=4, min_member=2, n_nodes=10)
+        cache = make_cache()
+        populate(cache)
+        ssn = open_session(
+            cache, make_tiers(["tpuscore"], *DEFAULT_TIERS,
+                              arguments=ROUNDS_ARGS))
+        mesh = Mesh(np.array(devs[:8]), ("nodes",))
+        ssn.plugins["tpuscore"].mesh = mesh
+        ssn.batch_allocator.mesh = mesh
+        get_action("allocate").execute(ssn)
+        prof = dict(ssn.plugins["tpuscore"].profile)
+        close_session(ssn)
+        assert prof.get("mode") == "rounds", prof
+        assert "fallback" not in prof, prof
+        # 160 tasks over 10x16-CPU nodes: every gang fits
+        assert len(cache.binder.binds) == 160, len(cache.binder.binds)
+        check_invariants(cache, 2)
+        # placements actually use the whole (non-padded) node range
+        used_nodes = set(cache.binder.binds.values())
+        assert len(used_nodes) >= 8, used_nodes
+        assert all(n.startswith("node-0000") for n in used_nodes)
+
+
+@pytest.mark.slow
+class TestMidScaleQualityGate:
+    def test_serial_vs_rounds_5k(self):
+        """~5k tasks, 250 nodes, 3 weighted queues: rounds mode must match
+        the serial oracle on placement count (within 5%), respect all
+        feasibility invariants, and reproduce the serial loop's fair-share
+        split across queues (within 10% of total)."""
+        populate = _mixed_cluster(
+            n_groups=1280, group_size=4, min_member=2, n_nodes=250,
+            queues=3)
+
+        serial_cache = make_cache()
+        populate(serial_cache)
+        ssn = open_session(serial_cache, make_tiers(
+            *DEFAULT_TIERS))
+        get_action("allocate").execute(ssn)
+        close_session(ssn)
+        serial = dict(serial_cache.binder.binds)
+
+        rounds_cache = make_cache()
+        populate(rounds_cache)
+        ssn = open_session(rounds_cache, make_tiers(
+            ["tpuscore"], *DEFAULT_TIERS, arguments=ROUNDS_ARGS))
+        get_action("allocate").execute(ssn)
+        prof = dict(ssn.plugins["tpuscore"].profile)
+        close_session(ssn)
+        rounds = dict(rounds_cache.binder.binds)
+        assert prof.get("mode") == "rounds", prof
+        assert "fallback" not in prof, prof
+
+        check_invariants(rounds_cache, 2)
+
+        # placement-count parity: every node sees all tasks in rounds mode
+        # (the serial loop samples), so rounds must not under-place
+        assert len(rounds) >= len(serial) * 0.95, (len(rounds), len(serial))
+
+        # fair-share: per-queue share of total bindings comparable
+        def queue_shares(binds):
+            per_q = {}
+            for key in binds:
+                g = int(key.split("/")[1][2:7])
+                q = f"q-{g % 3}"
+                per_q[q] = per_q.get(q, 0) + 1
+            total = max(sum(per_q.values()), 1)
+            return {q: n / total for q, n in per_q.items()}
+
+        s_shares = queue_shares(serial)
+        r_shares = queue_shares(rounds)
+        for q in s_shares:
+            assert abs(s_shares[q] - r_shares.get(q, 0.0)) < 0.10, (
+                s_shares, r_shares)

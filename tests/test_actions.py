@@ -187,3 +187,83 @@ class TestReclaim:
                            ["drf", "proportion", "predicates"])
         run_actions(c, tiers, "reclaim")
         assert c.evictor.evicts == []
+
+
+class TestAntiAffinitySymmetryIndex:
+    """The predicates plugin's anti_resident fast-path index must mirror
+    node-task membership exactly, matching the full-scan oracle through
+    allocate / evict (RELEASING stays resident) / statement rollback."""
+
+    def _anti(self, labels):
+        return objects.Affinity(
+            pod_anti_affinity=objects.PodAntiAffinity(required_terms=[
+                objects.PodAffinityTerm(
+                    label_selector=objects.LabelSelector(match_labels=labels),
+                    topology_key="kubernetes.io/hostname",
+                )
+            ])
+        )
+
+    def _cluster(self):
+        c = make_cache()
+        c.add_queue(build_queue("default"))
+        for n in ("n1", "n2"):
+            c.add_node(build_node(n, rl("8", "16Gi")))
+        c.add_pod_group(build_pod_group("guard", namespace="c1", min_member=1))
+        gpod = build_pod("c1", "guard-p0", "n1", objects.POD_PHASE_RUNNING,
+                         {"cpu": "1", "memory": "1Gi"}, "guard",
+                         labels={"app": "guard"})
+        gpod.spec.affinity = self._anti({"app": "web"})
+        c.add_pod(gpod)
+        c.add_pod_group(build_pod_group("web", namespace="c1", min_member=1))
+        c.add_pod(build_pod("c1", "web-p0", "", objects.POD_PHASE_PENDING,
+                            {"cpu": "1", "memory": "1Gi"}, "web",
+                            labels={"app": "web"}))
+        return c
+
+    def _fits(self, ssn, node_name):
+        from volcano_tpu.api.unschedule_info import FitFailure
+
+        task = next(iter(
+            ssn.jobs["c1/web"].task_status_index[TaskStatus.PENDING].values()))
+        try:
+            ssn.predicate_fn(task, ssn.nodes[node_name])
+            return True
+        except FitFailure:
+            return False
+
+    def test_symmetry_blocks_and_survives_evict(self):
+        c = self._cluster()
+        ssn = open_session(c, make_tiers(["gang"], ["predicates", "proportion"]))
+        assert not self._fits(ssn, "n1")  # guard's anti-affinity bars n1
+        assert self._fits(ssn, "n2")
+
+        # evict the guard: it stays on n1 as RELEASING, so symmetry must
+        # still bar n1 (matches the full-scan oracle over node.tasks)
+        guard = next(iter(
+            ssn.jobs["c1/guard"].task_status_index[TaskStatus.RUNNING].values()))
+        stmt = ssn.statement()
+        stmt.evict(guard, "test")
+        assert not self._fits(ssn, "n1")
+        stmt.discard()  # un-evict restores RUNNING; still resident
+        assert not self._fits(ssn, "n1")
+        close_session(ssn)
+
+    def test_index_tracks_statement_rollback(self):
+        # allocate a second anti-affinity pod onto n2, then roll back
+        c = self._cluster()
+        c.add_pod_group(build_pod_group("guard2", namespace="c1", min_member=1))
+        p2 = build_pod("c1", "guard2-p0", "", objects.POD_PHASE_PENDING,
+                       {"cpu": "1", "memory": "1Gi"}, "guard2",
+                       labels={"app": "guard2"})
+        p2.spec.affinity = self._anti({"app": "web"})
+        c.add_pod(p2)
+        ssn = open_session(c, make_tiers(["gang"], ["predicates", "proportion"]))
+        g2 = next(iter(
+            ssn.jobs["c1/guard2"].task_status_index[TaskStatus.PENDING].values()))
+        stmt = ssn.statement()
+        stmt.allocate(g2, "n2")
+        assert not self._fits(ssn, "n2")  # now barred by guard2 on n2
+        stmt.discard()
+        assert self._fits(ssn, "n2")  # rollback clears the residency
+        close_session(ssn)

@@ -20,6 +20,7 @@ class BackfillAction(Action):
         return "backfill"
 
     def execute(self, ssn) -> None:
+        all_nodes = helper.get_node_list(ssn.nodes)
         for job in list(ssn.jobs.values()):
             if job.pod_group.status.phase == objects.PodGroupPhase.PENDING:
                 continue
@@ -32,7 +33,7 @@ class BackfillAction(Action):
                     continue
                 allocated = False
                 fe = FitErrors()
-                for node in helper.get_node_list(ssn.nodes):
+                for node in all_nodes:
                     try:
                         ssn.predicate_fn(task, node)
                     except FitFailure as err:

@@ -16,12 +16,14 @@ so fit-error histograms are comparable.
 
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, List, Optional, Tuple
 
 from volcano_tpu.api import objects
 from volcano_tpu.api.job_info import TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.types import TaskStatus
 from volcano_tpu.api.unschedule_info import FitFailure
+from volcano_tpu.scheduler.framework.event_handlers import EventHandler
 from volcano_tpu.scheduler.framework.interface import Plugin
 
 PLUGIN_NAME = "predicates"
@@ -130,7 +132,27 @@ def _term_matches_no_pod_but_self(term: objects.PodAffinityTerm, pod: objects.Po
     return _selector_matches_pod(term, pod, pod.metadata.namespace)
 
 
-def pod_affinity_fits(pod: objects.Pod, node: NodeInfo, all_nodes: List[NodeInfo]) -> bool:
+def _has_required_anti_affinity(pod: Optional[objects.Pod]) -> bool:
+    if pod is None or pod.spec.affinity is None:
+        return False
+    anti = pod.spec.affinity.pod_anti_affinity
+    return anti is not None and bool(anti.required_terms)
+
+
+def pod_affinity_fits(
+    pod: objects.Pod,
+    node: NodeInfo,
+    all_nodes: List[NodeInfo],
+    anti_resident: Optional[Dict[str, Tuple[objects.Pod, str]]] = None,
+    nodes_by_name: Optional[Dict[str, NodeInfo]] = None,
+) -> bool:
+    """(Anti-)affinity of the incoming pod plus required-term symmetry of
+    existing pods. ``anti_resident`` (uid -> (pod, node_name)), when given,
+    is an exact mirror of the pods with required anti-affinity currently on
+    any node — the only pods the symmetry clause can match — letting the
+    common no-anti-affinity session skip the O(nodes x pods) sweep the
+    reference sidesteps with its affinity-only PodLister fast path
+    (plugins/util/util.go:34-57)."""
     affinity = pod.spec.affinity
     if affinity is not None:
         if affinity.pod_affinity is not None:
@@ -143,6 +165,18 @@ def pod_affinity_fits(pod: objects.Pod, node: NodeInfo, all_nodes: List[NodeInfo
                 if _anti_affinity_violated(term, pod, node, all_nodes):
                     return False
     # symmetry: existing pods' required anti-affinity must not match us
+    if anti_resident is not None and nodes_by_name is not None:
+        for existing, node_name in anti_resident.values():
+            other = nodes_by_name.get(node_name)
+            if other is None:
+                continue
+            for term in existing.spec.affinity.pod_anti_affinity.required_terms:
+                if not _selector_matches_pod(term, pod, existing.metadata.namespace):
+                    continue
+                topo = term.topology_key
+                if _node_topology_value(node, topo) == _node_topology_value(other, topo):
+                    return False
+        return True
     for other in all_nodes:
         for existing in _pods_on_node(other):
             ea = existing.spec.affinity
@@ -181,11 +215,42 @@ class PredicatesPlugin(Plugin):
         disk_pressure = args.get_bool(DISK_PRESSURE_PREDICATE, False)
         pid_pressure = args.get_bool(PID_PRESSURE_PREDICATE, False)
 
+        # The node set is fixed for the session; build the list once instead
+        # of per predicate call (the serial sweep calls this O(tasks x nodes)
+        # times).
+        all_nodes = list(ssn.nodes.values())
+
+        # anti_resident mirrors {pods with required anti-affinity currently
+        # in some node's task map}. Maintained through session events:
+        # allocate/pipeline add the task to a node; unallocate/unpipeline
+        # remove it; evict fires deallocate but leaves the task on the node
+        # as RELEASING (statement.py evict), so RELEASING deallocations are
+        # kept. Bulk-applied placements (ops/solver._apply_bulk) never carry
+        # (anti-)affinity — the encoder routes those tasks to the serial
+        # residue pass — so bypassing the event machinery cannot stale this
+        # index.
+        anti_resident: Dict[str, Tuple[objects.Pod, str]] = {}
+        for _node in all_nodes:
+            for _t in _node.tasks.values():
+                if _has_required_anti_affinity(_t.pod):
+                    anti_resident[_t.uid] = (_t.pod, _node.name)
+
+        def _track_allocate(event) -> None:
+            t = event.task
+            if _has_required_anti_affinity(t.pod) and t.node_name:
+                anti_resident[t.uid] = (t.pod, t.node_name)
+
+        def _track_deallocate(event) -> None:
+            t = event.task
+            if _has_required_anti_affinity(t.pod) and t.status != TaskStatus.RELEASING:
+                anti_resident.pop(t.uid, None)
+
+        ssn.add_event_handler(EventHandler(_track_allocate, _track_deallocate))
+
         def predicate_fn(task: TaskInfo, node: NodeInfo) -> None:
             pod = task.pod
             if pod is None:
                 return
-            all_nodes = list(ssn.nodes.values())
 
             # pod count (predicates.go:165)
             if node.allocatable.max_task_num <= len(node.tasks):
@@ -221,7 +286,9 @@ class PredicatesPlugin(Plugin):
                 raise FitFailure("node(s) had pid pressure")
 
             # pod (anti-)affinity incl. required-term symmetry
-            if not pod_affinity_fits(pod, node, all_nodes):
+            if (pod.spec.affinity is not None or anti_resident) and \
+                    not pod_affinity_fits(pod, node, all_nodes,
+                                          anti_resident, ssn.nodes):
                 raise FitFailure("node(s) didn't match pod affinity/anti-affinity")
 
         ssn.add_predicate_fn(PLUGIN_NAME, predicate_fn)

@@ -14,4 +14,4 @@ behind the plugin API so the serial loop remains as fallback and parity
 oracle.
 """
 
-__version__ = "0.1.0"
+from volcano_tpu.version import __version__  # noqa: E402,F401 (build metadata)

@@ -1,0 +1,192 @@
+"""vc-scheduler binary equivalent: ``python -m volcano_tpu.scheduler``.
+
+Maps the reference's flag surface (cmd/scheduler/app/options/options.go:78-108
++ server.go:76-160) onto the in-process substrate:
+
+- ``--scheduler-name/--scheduler-conf/--schedule-period/--default-queue`` as
+  in the reference;
+- ``--leader-elect`` runs the loop behind a store resource-lock election
+  (server.go:131-160); only the leader schedules;
+- ``--listen-address`` serves /metrics, ``--healthz-address`` serves
+  /healthz (server.go:97-100; apis/helpers.go:164);
+- node-sampling knobs land in options.ServerOpts exactly where
+  scheduler_helper reads them (scheduler_helper.go:43);
+- ``--cluster-state`` seeds the store from a YAML corpus (nodes/queues/jobs)
+  so a standalone run has something to schedule; without an external API
+  server the full cluster (controllers + kubelet sim) runs in-process.
+
+``--run-for N`` exits after N seconds (the e2e/smoke hook); default runs
+until SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+import yaml
+
+from volcano_tpu import version
+from volcano_tpu.scheduler import options
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(prog="vc-scheduler")
+    d = options.ServerOpts()
+    ap.add_argument("--scheduler-name", default=d.scheduler_name,
+                    help="only pods with this schedulerName are scheduled")
+    ap.add_argument("--scheduler-conf", default="",
+                    help="policy YAML path, hot-reloaded every cycle")
+    ap.add_argument("--schedule-period", type=float,
+                    default=d.schedule_period_seconds, metavar="SECONDS")
+    ap.add_argument("--default-queue", default=d.default_queue)
+    ap.add_argument("--leader-elect", action="store_true", default=False)
+    ap.add_argument("--lock-object-namespace", default="volcano-system")
+    ap.add_argument("--leader-elect-identity", default="",
+                    help="holder identity (default: host-pid)")
+    ap.add_argument("--listen-address", default=d.listen_address,
+                    help="metrics address (reference :8080)")
+    ap.add_argument("--healthz-address", default=d.healthz_address)
+    ap.add_argument("--minimum-feasible-nodes", type=int,
+                    default=d.min_nodes_to_find)
+    ap.add_argument("--minimum-percentage-of-nodes-to-find", type=int,
+                    default=d.min_percentage_of_nodes_to_find)
+    ap.add_argument("--percentage-of-nodes-to-find", type=int,
+                    default=d.percentage_of_nodes_to_find)
+    ap.add_argument("--cluster-state", default="",
+                    help="YAML corpus seeding nodes/queues/jobs (example/)")
+    ap.add_argument("--run-for", type=float, default=0.0,
+                    help="exit after N seconds (0 = until SIGINT)")
+    ap.add_argument("--version", action="store_true")
+    ap.add_argument("-v", "--verbosity", type=int, default=0)
+    return ap.parse_args(argv)
+
+
+def seed_cluster_state(store, path: str) -> None:
+    """Load a multi-document YAML corpus into the store: Node/Queue docs go
+    in directly; Job docs go through the CLI loader (admission applies)."""
+    from volcano_tpu.api import objects
+    from volcano_tpu.cli import job as job_cli
+
+    with open(path) as f:
+        docs = [d for d in yaml.safe_load_all(f.read()) if d]
+    for doc in docs:
+        kind = doc.get("kind", "")
+        meta = doc.get("metadata", {}) or {}
+        if kind == "Node":
+            cap = (doc.get("status", {}) or {}).get("capacity", {}) or {}
+            capacity = {
+                "cpu": str(cap.get("cpu", "8")),
+                "memory": str(cap.get("memory", "16Gi")),
+                "pods": str(cap.get("pods", "110")),
+            }
+            node = objects.Node(
+                metadata=objects.ObjectMeta(
+                    name=meta.get("name", "node"),
+                    labels=dict(meta.get("labels") or {})),
+                status=objects.NodeStatus(
+                    capacity=dict(capacity), allocatable=dict(capacity),
+                    conditions=[objects.NodeCondition(
+                        type="Ready", status="True")]))
+            if store.try_get("Node", "", node.metadata.name) is None:
+                store.create(node)
+        elif kind == "Queue":
+            spec = doc.get("spec", {}) or {}
+            q = objects.Queue(
+                metadata=objects.ObjectMeta(name=meta.get("name", "default")),
+                spec=objects.QueueSpec(weight=int(spec.get("weight", 1))))
+            if store.try_get("Queue", "", q.metadata.name) is None:
+                store.create(q)
+        elif kind == "Job":
+            job_cli.run_job(store, yaml.safe_dump(doc))
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.version:
+        sys.stdout.write(version.version_string())
+        return 0
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbosity >= 3 else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+
+    # flags land in the global ServerOpts read by scheduler_helper
+    o = options.server_opts
+    o.scheduler_name = args.scheduler_name
+    o.scheduler_conf = args.scheduler_conf
+    o.schedule_period_seconds = args.schedule_period
+    o.default_queue = args.default_queue
+    o.enable_leader_election = args.leader_elect
+    o.min_nodes_to_find = args.minimum_feasible_nodes
+    o.min_percentage_of_nodes_to_find = args.minimum_percentage_of_nodes_to_find
+    o.percentage_of_nodes_to_find = args.percentage_of_nodes_to_find
+    o.listen_address = args.listen_address
+    o.healthz_address = args.healthz_address
+
+    from volcano_tpu.cluster import Cluster
+    from volcano_tpu.scheduler.httpserver import ObservabilityServer
+
+    cluster = Cluster(
+        scheduler_name=args.scheduler_name,
+        default_queue=args.default_queue,
+        schedule_period=args.schedule_period)
+    if args.scheduler_conf:
+        cluster.scheduler.conf_path = args.scheduler_conf
+    if args.cluster_state:
+        seed_cluster_state(cluster.store, args.cluster_state)
+
+    stop_evt = threading.Event()
+    metrics_srv = ObservabilityServer(args.listen_address).start()
+    healthz_srv = ObservabilityServer(
+        args.healthz_address, healthy=lambda: not stop_evt.is_set()).start()
+    logging.info("metrics on :%d/metrics, healthz on :%d/healthz",
+                 metrics_srv.port, healthz_srv.port)
+
+    elector = None
+    if args.leader_elect:
+        import os
+        import socket
+
+        from volcano_tpu.scheduler.leaderelection import (
+            LeaderElector, ResourceLock)
+
+        identity = (args.leader_elect_identity
+                    or f"{socket.gethostname()}-{os.getpid()}")
+        lock = ResourceLock(
+            cluster.store, args.lock_object_namespace,
+            args.scheduler_name, identity)
+        elector = LeaderElector(
+            lock,
+            on_started_leading=cluster.run,
+            on_stopped_leading=lambda: cluster.stop())
+        elector.start()
+        logging.info("leader election enabled (identity=%s)", identity)
+    else:
+        cluster.run()
+
+    def on_signal(signum, frame):
+        stop_evt.set()
+
+    try:
+        signal.signal(signal.SIGINT, on_signal)
+        signal.signal(signal.SIGTERM, on_signal)
+    except ValueError:
+        pass  # not the main thread (tests drive main() directly)
+
+    stop_evt.wait(timeout=args.run_for or None)
+    stop_evt.set()
+
+    if elector is not None:
+        elector.stop()
+    else:
+        cluster.stop()
+    metrics_srv.stop()
+    healthz_srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

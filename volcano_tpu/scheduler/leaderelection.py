@@ -1,0 +1,233 @@
+"""Leader election over a store resource lock.
+
+The reference runs the scheduler and the controller manager as active/passive
+HA pairs coordinated through a ConfigMap resource lock
+(/root/reference/cmd/scheduler/app/server.go:131-160,
+cmd/controllers/app/server.go:110-140, using client-go leaderelection with
+lease 15s / renew 10s / retry 5s, server.go:52-54). This module is the
+in-process analog: the lock record lives in a ConfigMap in the store, writes
+go through the store's compare-and-swap (`Store.update(expect_version=...)`),
+and candidates race exactly as the client-go implementation does — read the
+record, and either (a) find it expired and try to take it, or (b) find
+themselves the holder and renew. Exactly one candidate holds the lease at any
+moment; the holder runs its workload callback, and a holder that fails to
+renew inside the renew deadline stops leading so the standby can take over.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from volcano_tpu.api import objects
+from volcano_tpu.store.store import ConflictError, Store
+
+logger = logging.getLogger(__name__)
+
+# client-go defaults used by the reference (cmd/scheduler/app/server.go:52-54)
+DEFAULT_LEASE_DURATION = 15.0
+DEFAULT_RENEW_DEADLINE = 10.0
+DEFAULT_RETRY_PERIOD = 5.0
+
+_RECORD_KEY = "control-plane.alpha.volcano/leader"
+
+
+@dataclass
+class LeaderElectionRecord:
+    holder_identity: str
+    lease_duration: float
+    acquire_time: float
+    renew_time: float
+    leader_transitions: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__)
+
+    @classmethod
+    def from_json(cls, text: str) -> "LeaderElectionRecord":
+        return cls(**json.loads(text))
+
+
+class ResourceLock:
+    """ConfigMap-annotation resource lock (client-go resourcelock semantics:
+    the record is serialized into an annotation; create/update are guarded by
+    the store's optimistic concurrency)."""
+
+    def __init__(self, store: Store, namespace: str, name: str, identity: str):
+        self.store = store
+        self.namespace = namespace
+        self.name = name
+        self.identity = identity
+
+    def get(self) -> Optional[tuple]:
+        """(record, resource_version) or None when the lock doesn't exist."""
+        cm = self.store.try_get("ConfigMap", self.namespace, self.name)
+        if cm is None:
+            return None
+        raw = (cm.metadata.annotations or {}).get(_RECORD_KEY)
+        if not raw:
+            return None
+        try:
+            return (LeaderElectionRecord.from_json(raw),
+                    cm.metadata.resource_version)
+        except (ValueError, TypeError):
+            return None
+
+    def create(self, record: LeaderElectionRecord) -> bool:
+        cm = objects.ConfigMap(
+            metadata=objects.ObjectMeta(
+                name=self.name, namespace=self.namespace,
+                annotations={_RECORD_KEY: record.to_json()}))
+        try:
+            self.store.create(cm)
+            return True
+        except ConflictError:
+            return False
+
+    def update(self, record: LeaderElectionRecord, expect_version: int) -> bool:
+        cm = self.store.try_get("ConfigMap", self.namespace, self.name)
+        if cm is None:
+            return False
+        annotations = dict(cm.metadata.annotations or {})
+        annotations[_RECORD_KEY] = record.to_json()
+        new = objects.ConfigMap(
+            metadata=objects.ObjectMeta(
+                name=self.name, namespace=self.namespace,
+                annotations=annotations))
+        new.metadata.uid = cm.metadata.uid
+        new.metadata.creation_timestamp = cm.metadata.creation_timestamp
+        try:
+            self.store.update(new, expect_version=expect_version)
+            return True
+        except (ConflictError, KeyError):
+            return False
+
+
+class LeaderElector:
+    """Run-loop elector: acquire -> on_started_leading, renew until lost ->
+    on_stopped_leading. `run()` blocks until `stop()`; callbacks fire on the
+    elector thread. `is_leader()` is safe from any thread."""
+
+    def __init__(
+        self,
+        lock: ResourceLock,
+        on_started_leading: Callable[[], None],
+        on_stopped_leading: Callable[[], None],
+        on_new_leader: Optional[Callable[[str], None]] = None,
+        lease_duration: float = DEFAULT_LEASE_DURATION,
+        renew_deadline: float = DEFAULT_RENEW_DEADLINE,
+        retry_period: float = DEFAULT_RETRY_PERIOD,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if renew_deadline >= lease_duration:
+            raise ValueError("renew_deadline must be < lease_duration")
+        if retry_period >= renew_deadline:
+            raise ValueError("retry_period must be < renew_deadline")
+        self.lock = lock
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.on_new_leader = on_new_leader
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self._clock = clock
+        self._stop = threading.Event()
+        self._leading = False
+        self._observed_holder = ""
+        self._thread: Optional[threading.Thread] = None
+
+    # -- public ------------------------------------------------------------
+
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def start(self) -> None:
+        """Run the elector loop on a daemon thread."""
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.renew_deadline + 1.0)
+
+    def run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                if self._try_acquire_or_renew():
+                    if not self._leading:
+                        self._leading = True
+                        logger.info("%s became leader", self.lock.identity)
+                        self.on_started_leading()
+                    self._stop.wait(self.retry_period)
+                else:
+                    if self._leading:
+                        self._leading = False
+                        logger.info("%s lost leadership", self.lock.identity)
+                        self.on_stopped_leading()
+                    self._stop.wait(self.retry_period)
+        finally:
+            if self._leading:
+                self._leading = False
+                self._release()
+                self.on_stopped_leading()
+
+    # -- internals ---------------------------------------------------------
+
+    def _observe(self, holder: str) -> None:
+        if holder != self._observed_holder:
+            self._observed_holder = holder
+            if self.on_new_leader is not None:
+                self.on_new_leader(holder)
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = self._clock()
+        identity = self.lock.identity
+        got = self.lock.get()
+
+        if got is None:
+            record = LeaderElectionRecord(
+                holder_identity=identity,
+                lease_duration=self.lease_duration,
+                acquire_time=now, renew_time=now)
+            if self.lock.create(record):
+                self._observe(identity)
+                return True
+            return False  # raced; retry next period
+
+        record, version = got
+        self._observe(record.holder_identity)
+        if record.holder_identity != identity:
+            if now < record.renew_time + self.lease_duration:
+                return False  # current leader still within its lease
+            # lease expired: try to take over (CAS rejects racing standbys)
+            new = LeaderElectionRecord(
+                holder_identity=identity,
+                lease_duration=self.lease_duration,
+                acquire_time=now, renew_time=now,
+                leader_transitions=record.leader_transitions + 1)
+            return self.lock.update(new, version)
+
+        # we are the holder: renew
+        record.renew_time = now
+        record.lease_duration = self.lease_duration
+        if self.lock.update(record, version):
+            return True
+        # CAS failure while holding means someone stole an expired lease
+        return False
+
+    def _release(self) -> None:
+        """Drop the lease on clean shutdown so the standby takes over in one
+        retry period instead of a full lease duration."""
+        got = self.lock.get()
+        if got is None:
+            return
+        record, version = got
+        if record.holder_identity != self.lock.identity:
+            return
+        record.renew_time = 0.0  # expired immediately
+        self.lock.update(record, version)

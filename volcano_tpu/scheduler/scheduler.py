@@ -138,28 +138,36 @@ class Scheduler:
 
     def run(self) -> None:
         """Start cache sync then the periodic loop in a background thread
-        (scheduler.go:63-69)."""
+        (scheduler.go:63-69). Restartable: a leader elector may stop the
+        loop on lost leadership and run it again on re-election."""
         self.cache.run()
         self.cache.wait_for_cache_sync()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        # fresh Event per generation: if stop()'s bounded join left a
+        # previous loop thread mid-run_once, that zombie still sees ITS
+        # (set) event and exits; clearing a shared event would revive it
+        # alongside the new thread — two loops binding against one cache
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, args=(self._stop,), daemon=True)
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, stop_cache: bool = True) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
-        if hasattr(self.cache, "stop"):
+            self._thread = None
+        if stop_cache and hasattr(self.cache, "stop"):
             self.cache.stop()
 
-    def _loop(self) -> None:
-        while not self._stop.is_set():
+    def _loop(self, stop: threading.Event) -> None:
+        while not stop.is_set():
             start = time.perf_counter()
             try:
                 self.run_once()
             except Exception:
                 logger.exception("scheduling cycle failed")
             elapsed = time.perf_counter() - start
-            self._stop.wait(max(self.schedule_period - elapsed, 0.0))
+            stop.wait(max(self.schedule_period - elapsed, 0.0))
 
     # -- one cycle ---------------------------------------------------------
 

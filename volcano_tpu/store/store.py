@@ -124,7 +124,12 @@ class Store:
             self._dispatch(kind, "ADDED", None, obj)
             return obj
 
-    def update(self, obj) -> object:
+    def update(self, obj, expect_version: Optional[int] = None) -> object:
+        """Replace an object. With ``expect_version`` the write is a
+        compare-and-swap: it fails with ConflictError unless the stored
+        object's resource_version still matches — the optimistic-concurrency
+        primitive the k8s API server provides and the reference's
+        resource-lock leader election depends on."""
         kind = type(obj).KIND
         with self._lock:
             key = object_key(obj)
@@ -132,6 +137,11 @@ class Store:
             old = bucket.get(key)
             if old is None:
                 raise NotFoundError(f"{kind} {key} not found")
+            if (expect_version is not None
+                    and old.metadata.resource_version != expect_version):
+                raise ConflictError(
+                    f"{kind} {key}: version {old.metadata.resource_version} "
+                    f"!= expected {expect_version}")
             self._resource_version += 1
             obj.metadata.resource_version = self._resource_version
             bucket[key] = obj

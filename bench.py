@@ -36,6 +36,8 @@ def _session_once(cache, tiers, actions, mesh=None):
         from volcano_tpu.scheduler.plugins import tpuscore
 
         tpuscore.set_default_mesh(mesh)
+    if _GC_POLICY is not None:
+        _GC_POLICY.maintain()  # between-cycle collection, as in the loop
     t0 = time.perf_counter()
     ssn = open_session(cache, tiers)
     t_open = time.perf_counter()
@@ -131,7 +133,18 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
     return out
 
 
+_GC_POLICY = None
+
+
 def main() -> int:
+    global _GC_POLICY
+    from volcano_tpu.utils.gcpolicy import LowLatencyGC
+
+    # the production scheduler loop runs under this policy (Scheduler._loop);
+    # measuring without it would charge random full-heap GC pauses to
+    # whichever phase they land in. run_config calls maintain() between
+    # sessions, mirroring the loop's between-cycle collections.
+    _GC_POLICY = LowLatencyGC.install()
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=None, choices=[1, 2, 3, 4, 5],
                     help="run ONE config (default: all five, headline = cfg 5)")

@@ -97,6 +97,21 @@ class TestLeaderElection:
         assert _wait(eb.is_leader, timeout=2.0)  # expiry -> takeover
         eb.stop()
 
+    def test_corrupt_record_recovered_via_cas_update(self):
+        """Lock ConfigMap exists but its record annotation is garbage: the
+        elector must claim it through the CAS update path (create would
+        conflict forever and deadlock the election)."""
+        store = Store()
+        cm = objects.ConfigMap(metadata=objects.ObjectMeta(
+            name="vc-scheduler", namespace="volcano-system",
+            annotations={"control-plane.alpha.volcano/leader": "{not json"}))
+        store.create(cm)
+        lock = ResourceLock(store, "volcano-system", "vc-scheduler", "a")
+        el = LeaderElector(lock, lambda: None, lambda: None, **FAST)
+        el.start()
+        assert _wait(el.is_leader, timeout=2.0)
+        el.stop()
+
     def test_exactly_one_scheduler_binds(self):
         """VERDICT r1 missing #1: two scheduler instances over one store,
         exactly one (the leader) binds; failover moves binding authority."""

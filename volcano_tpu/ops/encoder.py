@@ -321,23 +321,51 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
     def order_key(a: TaskInfo, b: TaskInfo) -> int:
         return -1 if ssn.task_order_fn(a, b) else (1 if ssn.task_order_fn(b, a) else 0)
 
+    # gather every job's pending tasks-with-requests (job-major, so each
+    # job's block is contiguous after the job-primary sort below)
+    all_tasks: List[TaskInfo] = []
+    job_of: List[int] = []
+    for ji, job in enumerate(jobs):
+        pend = job.task_status_index.get(TaskStatus.PENDING)
+        if not pend:
+            continue
+        for t in pend.values():
+            if not t.resreq.is_empty():
+                all_tasks.append(t)
+                job_of.append(ji)
+    p_count = len(all_tasks)
+
     # fast path: the priority plugin is the only stock task-order fn; its
     # comparator is exactly this key tuple (priority.py:20-24 + the session
-    # creation/uid tie-break) and a key sort is ~10x cheaper than cmp_to_key
+    # creation/uid tie-break), so ONE C-level lexsort over all pending tasks
+    # replaces J Python comparator sorts (the encoder's former hot spot)
     task_order_plugins = set(
         _enabled_plugins(ssn, "enabled_task_order", ssn.task_order_fns))
-    if task_order_plugins <= {"priority"}:
-        prio_on = bool(task_order_plugins)
-
-        def sort_pending(pending: List[TaskInfo]) -> None:
-            pending.sort(key=lambda t: (
-                -t.priority if prio_on else 0,
-                t.pod.metadata.creation_timestamp if t.pod else 0,
-                t.uid,
-            ))
+    if p_count == 0:
+        order: List[int] = []
+    elif task_order_plugins <= {"priority"}:
+        prio = (np.fromiter((t.priority for t in all_tasks), np.int64, p_count)
+                if task_order_plugins else np.zeros(p_count, np.int64))
+        ctime = np.fromiter(
+            ((t.pod.metadata.creation_timestamp if t.pod is not None else 0.0)
+             for t in all_tasks), np.float64, p_count)
+        uid = np.array([t.uid for t in all_tasks])
+        order = np.lexsort(
+            (uid, ctime, -prio, np.asarray(job_of, np.int64))).tolist()
     else:
-        def sort_pending(pending: List[TaskInfo]) -> None:
-            pending.sort(key=cmp_to_key(order_key))
+        # custom task-order fns: per-job comparator sort (job blocks are
+        # contiguous in job_of by construction)
+        order = []
+        lo = 0
+        while lo < p_count:
+            hi = lo
+            while hi < p_count and job_of[hi] == job_of[lo]:
+                hi += 1
+            idxs = sorted(range(lo, hi),
+                          key=cmp_to_key(
+                              lambda x, y: order_key(all_tasks[x], all_tasks[y])))
+            order.extend(idxs)
+            lo = hi
 
     # with live anti-affinity symmetry terms, mask membership depends on a
     # pod's labels AND namespace (selector matching) — extend the signature
@@ -347,39 +375,40 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
     sym_active = bool(sym_terms)
 
     job_residue = np.zeros(j_count, np.int32)
-    for ji, job in enumerate(jobs):
-        pending = [
-            t
-            for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
-            if not t.resreq.is_empty()
-        ]
-        sort_pending(pending)
-        job_task_start[ji] = len(task_infos)
-        for t in pending:
-            if t.pod is None:
-                key = "<none>"
-            else:
-                key, ports, aff = _pod_encode_traits(t.pod)
-                if aff:
-                    if not allow_residue:
-                        raise EncoderFallback("pod (anti-)affinity not modeled")
-                    job_residue[ji] += 1
-                    continue
-                if ports:
-                    if not allow_residue:
-                        raise EncoderFallback("host ports not modeled")
-                    job_residue[ji] += 1
-                    continue
-            if sym_active and t.pod is not None:
+    cur_ji = -1
+    for oi in order:
+        t = all_tasks[oi]
+        ji = job_of[oi]
+        if ji != cur_ji:
+            if cur_ji >= 0:
+                job_task_count[cur_ji] = len(task_infos) - int(job_task_start[cur_ji])
+            job_task_start[ji] = len(task_infos)
+            cur_ji = ji
+        if t.pod is None:
+            key = "<none>"
+        else:
+            key, ports, aff = _pod_encode_traits(t.pod)
+            if aff:
+                if not allow_residue:
+                    raise EncoderFallback("pod (anti-)affinity not modeled")
+                job_residue[ji] += 1
+                continue
+            if ports:
+                if not allow_residue:
+                    raise EncoderFallback("host ports not modeled")
+                job_residue[ji] += 1
+                continue
+            if sym_active:
                 key = (f"{key}|labels={sorted(t.pod.metadata.labels.items())!r}"
                        f"|ns={t.pod.metadata.namespace}")
-            si = sig_index.get(key)
-            if si is None:
-                si = sig_index[key] = len(sig_rep)
-                sig_rep.append(t)
-            task_sig.append(si)
-            task_infos.append(t)
-        job_task_count[ji] = len(task_infos) - int(job_task_start[ji])
+        si = sig_index.get(key)
+        if si is None:
+            si = sig_index[key] = len(sig_rep)
+            sig_rep.append(t)
+        task_sig.append(si)
+        task_infos.append(t)
+    if cur_ji >= 0:
+        job_task_count[cur_ji] = len(task_infos) - int(job_task_start[cur_ji])
     t_count = len(task_infos)
     s_count = max(len(sig_rep), 1)
 
@@ -401,6 +430,48 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
                            nodeorder_mod.DEFAULT_MEMORY_REQUEST)
     task_has_pod = np.array([t.pod is not None for t in task_infos], bool) \
         if task_infos else np.zeros(0, bool)
+
+    # ---- task equivalence classes ------------------------------------------
+    # tasks stamped from one template share (req, initreq, signature,
+    # has_pod) and therefore produce IDENTICAL feasibility/score rows in the
+    # rounds sweep; deduping collapses the (T x N) sweep to (K x N) with
+    # K ~ #templates << T (the TPU-native analog of the reference's
+    # per-template predicate work, equivalence classes instead of sampling)
+    task_sig_arr = (np.array(task_sig, np.int32)
+                    if task_sig else np.zeros(0, np.int32))
+    if t_count:
+        cls_key = np.ascontiguousarray(np.concatenate(
+            [task_req, task_initreq,
+             task_sig_arr[:, None].astype(np.float64),
+             task_has_pod[:, None].astype(np.float64)], axis=1))
+        # byte-view unique: one memcmp sort instead of np.unique(axis=0)'s
+        # per-column lexsort; byte equality == value equality here (all
+        # finite, non-negative floats), and class IDs carry no semantics
+        row_bytes = cls_key.view(
+            np.dtype((np.void, cls_key.dtype.itemsize * cls_key.shape[1]))
+        ).ravel()
+        _, first_idx, task_cls = np.unique(
+            row_bytes, return_index=True, return_inverse=True)
+        task_cls = task_cls.astype(np.int32)
+        cls_rows = cls_key[first_idx]
+        k_count = cls_rows.shape[0]
+        cls_req = cls_rows[:, :R]
+        cls_initreq = cls_rows[:, R:2 * R]
+        cls_sig = cls_rows[:, 2 * R].astype(np.int32)
+        cls_has_pod = cls_rows[:, 2 * R + 1] != 0
+        cls_nz_cpu = np.where(cls_req[:, 0] != 0, cls_req[:, 0],
+                              nodeorder_mod.DEFAULT_MILLI_CPU_REQUEST)
+        cls_nz_mem = np.where(cls_req[:, 1] != 0, cls_req[:, 1],
+                              nodeorder_mod.DEFAULT_MEMORY_REQUEST)
+    else:
+        task_cls = np.zeros(0, np.int32)
+        k_count = 1
+        cls_req = np.zeros((1, R), np.float64)
+        cls_initreq = np.zeros((1, R), np.float64)
+        cls_sig = np.zeros(1, np.int32)
+        cls_has_pod = np.zeros(1, bool)
+        cls_nz_cpu = np.full(1, nodeorder_mod.DEFAULT_MILLI_CPU_REQUEST)
+        cls_nz_mem = np.full(1, nodeorder_mod.DEFAULT_MEMORY_REQUEST)
 
     # ---- static predicate masks per signature ------------------------------
     pred_args = _plugin_args(ssn, "predicates")
@@ -627,8 +698,15 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
         task_initreq=task_initreq,
         task_nz_cpu=task_nz_cpu,
         task_nz_mem=task_nz_mem,
-        task_sig=np.array(task_sig, np.int32) if task_sig else np.zeros(0, np.int32),
+        task_sig=task_sig_arr,
         task_has_pod=task_has_pod,
+        task_cls=task_cls,
+        cls_req=cls_req,
+        cls_initreq=cls_initreq,
+        cls_nz_cpu=cls_nz_cpu,
+        cls_nz_mem=cls_nz_mem,
+        cls_sig=cls_sig,
+        cls_has_pod=cls_has_pod,
         task_job=np.repeat(
             np.arange(j_count, dtype=np.int32), job_task_count
         ) if t_count else np.zeros(0, np.int32),

@@ -74,27 +74,43 @@ def _job_rank(spec: SolveSpec, enc, job_placed, job_alloc):
 
 
 def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
-    """Per-task best feasible node: chunked masked argmax.
+    """Per-task best feasible node via task equivalence classes.
 
-    Returns (choice [T] int32, -1 when nothing feasible)."""
-    t_total = enc["task_req"].shape[0]
-    chunk = min(CHUNK, t_total)  # both are powers of two (solver buckets)
-    n_chunks = t_total // chunk
+    Tasks stamped from one template share (req, initreq, signature,
+    has_pod) — encoder.task_cls — and therefore produce IDENTICAL masked
+    score rows, so the sweep is (K x N) over classes with K ~ #templates
+    << T, then per-task gathers pick the (t mod n_tied)-th tied-best node.
+    Output is identical to a per-task (T x N) sweep: the tie-spreading key
+    was already the flat task index (divergence from the serial min-name
+    tie-break, see module doc), and everything else in a task's row is a
+    pure function of its class.
+
+    Returns (choice [T] int32, -1 when nothing feasible/inactive)."""
+    k_total = enc["cls_req"].shape[0]
+    n_total = idle.shape[0]
     eps = enc["eps"]
     is_scalar = enc["is_scalar"]
     neg = jnp.array(-jnp.inf, idle.dtype)
+    task_cls = enc["task_cls"]
+
+    # a class is live iff any of its tasks is still active; dead-class
+    # chunks skip the (chunk x N) sweep (late rounds: most classes placed)
+    cls_live = jnp.zeros(k_total, bool).at[task_cls].max(active)
+
+    chunk = min(CHUNK, k_total)  # both powers of two (solver buckets)
+    n_chunks = k_total // chunk
 
     def one_chunk(ci):
         sl = ci * chunk
-        act = lax.dynamic_slice_in_dim(active, sl, chunk)
+        live = lax.dynamic_slice_in_dim(cls_live, sl, chunk)
 
         def sweep(_):
-            req = lax.dynamic_slice_in_dim(enc["task_req"], sl, chunk)
-            initreq = lax.dynamic_slice_in_dim(enc["task_initreq"], sl, chunk)
-            sig = lax.dynamic_slice_in_dim(enc["task_sig"], sl, chunk)
-            nz_cpu = lax.dynamic_slice_in_dim(enc["task_nz_cpu"], sl, chunk)
-            nz_mem = lax.dynamic_slice_in_dim(enc["task_nz_mem"], sl, chunk)
-            has_pod = lax.dynamic_slice_in_dim(enc["task_has_pod"], sl, chunk)
+            req = lax.dynamic_slice_in_dim(enc["cls_req"], sl, chunk)
+            initreq = lax.dynamic_slice_in_dim(enc["cls_initreq"], sl, chunk)
+            sig = lax.dynamic_slice_in_dim(enc["cls_sig"], sl, chunk)
+            nz_cpu = lax.dynamic_slice_in_dim(enc["cls_nz_cpu"], sl, chunk)
+            nz_mem = lax.dynamic_slice_in_dim(enc["cls_nz_mem"], sl, chunk)
+            has_pod = lax.dynamic_slice_in_dim(enc["cls_has_pod"], sl, chunk)
 
             # epsilon fit of init requests against idle (resource_info.go:267)
             le = initreq[:, None, :] < idle[None, :, :] + eps[None, None, :]
@@ -104,33 +120,44 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
             if spec.check_pod_count:
                 mask = mask & ((cnt[None, :] < enc["node_max_tasks"][None, :])
                                | ~has_pod[:, None])
-            mask = mask & act[:, None]
 
             score = fused_scores(spec, enc, used, req, nz_cpu, nz_mem, sig)
             masked = jnp.where(mask, score, neg)
-            # deterministic tie spreading: scores are coarse (floor-based), so
-            # whole gangs tie on one node and would fill the cluster one node
-            # per round; among the tied best nodes, task t takes the
-            # (t mod n_tied)-th — exact-tie-only, score order is untouched
-            # (divergence from the serial min-name tie-break, see module doc)
+            # deterministic tie spreading: scores are coarse (floor-based),
+            # so whole gangs tie on one node and would fill the cluster one
+            # node per round; enumerate each class's tied-best nodes so task
+            # t can take the (t mod n_tied)-th — exact-tie-only, score order
+            # untouched
             m = jnp.max(masked, axis=-1, keepdims=True)
             tied = (masked == m) & mask                       # [C, N]
-            n_tied = jnp.sum(tied, axis=-1)                   # [C]
-            t_idx = sl + jnp.arange(chunk)
-            kth = (t_idx % jnp.maximum(n_tied, 1)).astype(jnp.int32)
+            n_tied = jnp.sum(tied, axis=-1).astype(jnp.int32)  # [C]
             csum = jnp.cumsum(tied.astype(jnp.int32), axis=-1)
-            best = jnp.argmax(tied & (csum == (kth + 1)[:, None]), axis=-1).astype(jnp.int32)
-            feasible = jnp.any(mask, axis=-1)
-            return jnp.where(feasible, best, -1)
+            # tied_list[k, j] = node index of the (j+1)-th tied node; the
+            # extra trailing column absorbs the non-tied scatters
+            pos = jnp.where(tied, csum - 1, n_total)
+            cols = jnp.broadcast_to(
+                jnp.arange(n_total, dtype=jnp.int32)[None, :], (chunk, n_total))
+            rows = jnp.broadcast_to(
+                jnp.arange(chunk)[:, None], (chunk, n_total))
+            tied_list = jnp.zeros((chunk, n_total + 1), jnp.int32) \
+                .at[rows, pos].set(cols)
+            return tied_list[:, :n_total], n_tied
 
-        # late rounds have few live tasks: skip the (chunk x N) sweep for
-        # chunks whose tasks are all placed/retired (XLA conditional executes
-        # one branch only, so a dead chunk costs O(chunk) not O(chunk x N))
-        return lax.cond(jnp.any(act), sweep,
-                        lambda _: jnp.full((chunk,), -1, jnp.int32), None)
+        return lax.cond(
+            live.any(), sweep,
+            lambda _: (jnp.zeros((chunk, n_total), jnp.int32),
+                       jnp.zeros((chunk,), jnp.int32)), None)
 
-    chunks = lax.map(one_chunk, jnp.arange(n_chunks))
-    return chunks.reshape(t_total)
+    tied_list, n_tied = lax.map(one_chunk, jnp.arange(n_chunks))
+    tied_list = tied_list.reshape(k_total, n_total)
+    n_tied = n_tied.reshape(k_total)
+
+    t_total = task_cls.shape[0]
+    nt = n_tied[task_cls]                                     # [T]
+    kth = (jnp.arange(t_total, dtype=jnp.int32)
+           % jnp.maximum(nt, 1)).astype(jnp.int32)
+    choice = tied_list[task_cls, kth]
+    return jnp.where((nt > 0) & active, choice, -1)
 
 
 def _seg_limbs(req_s, start_idx):
@@ -306,10 +333,20 @@ def solve_rounds_packed(spec: SolveSpec, layout, f_buf, i_buf, b_buf):
 @functools.partial(jax.jit, static_argnames=("spec",))
 def solve_rounds(spec: SolveSpec, enc: dict):
     """Batched allocate session. Returns (assign [T] int32 node or -1,
-    rounds used)."""
-    t_total = enc["task_req"].shape[0]
+    rounds used).
+
+    Per-task request/has-pod columns are derived on device from the class
+    arrays (task_req = cls_req[task_cls]); the per-task float matrices never
+    cross the host->device hop in rounds mode (solver ships class arrays +
+    the int32 task_cls index only)."""
+    t_total = enc["task_cls"].shape[0]
     j_total = enc["job_tie_rank"].shape[0]
-    dt = enc["task_req"].dtype
+    dt = enc["cls_req"].dtype
+    enc = dict(
+        enc,
+        task_req=enc["cls_req"][enc["task_cls"]],
+        task_has_pod=enc["cls_has_pod"][enc["task_cls"]],
+    )
 
     task_job = enc["task_job"]
     task_queue = enc["job_queue"][task_job]

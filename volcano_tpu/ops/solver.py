@@ -57,6 +57,14 @@ _NODE_AXIS = {
     "node_cnt": 0, "node_max_tasks": 0, "node_real": 0,
 }
 
+# arrays the rounds kernel never reads: per-task columns it re-derives from
+# the class arrays on device (rounds.solve_rounds), plus the parity scan's
+# sampling-window inputs — excluded from the rounds host->device transfer
+_ROUNDS_SKIP = frozenset({
+    "task_req", "task_initreq", "task_nz_cpu", "task_nz_mem",
+    "task_sig", "task_has_pod", "node_real", "real_n",
+})
+
 
 def pad_encoded(enc: EncodedSnapshot, node_multiple: int = 1) -> Dict[str, np.ndarray]:
     """Pad the churny axes (tasks, jobs) to buckets. The node axis is padded
@@ -67,8 +75,13 @@ def pad_encoded(enc: EncodedSnapshot, node_multiple: int = 1) -> Dict[str, np.nd
     tb, jb = _bucket(t), _bucket(j)
     a = dict(enc.arrays)
     for name in ("task_req", "task_initreq", "task_nz_cpu", "task_nz_mem",
-                 "task_sig", "task_has_pod", "task_job"):
+                 "task_sig", "task_has_pod", "task_job", "task_cls"):
         a[name] = _pad_axis(a[name], 0, tb)
+    kb = _bucket(a["cls_req"].shape[0])
+    for name in ("cls_req", "cls_initreq", "cls_nz_cpu", "cls_nz_mem",
+                 "cls_sig", "cls_has_pod"):
+        a[name] = _pad_axis(a[name], 0, kb,
+                            fill=False if name == "cls_has_pod" else 0)
     for name in (
         "job_task_start", "job_task_count", "job_queue", "job_ns",
         "job_priority", "job_min_available", "job_ready_base",
@@ -229,10 +242,12 @@ class BatchAllocator:
             if mode == "rounds":
                 from volcano_tpu.ops import rounds as rounds_mod
 
+                rounds_arrays = {
+                    k: v for k, v in arrays.items() if k not in _ROUNDS_SKIP}
                 if self.mesh is None:
                     # single buffer per dtype: 3 host->device transfers
                     # instead of ~46 (each pays a fixed tunnel RTT)
-                    layout, bufs = _pack(arrays)
+                    layout, bufs = _pack(rounds_arrays)
                     tp = time.perf_counter()
                     assign, n_rounds = rounds_mod.solve_rounds_packed(
                         enc.spec, layout, bufs["f"], bufs["i"], bufs["b"])
@@ -241,7 +256,8 @@ class BatchAllocator:
                 else:
                     # mesh path keeps per-array puts: node-axis arrays carry
                     # NamedShardings that packing would destroy
-                    assign, n_rounds = rounds_mod.solve_rounds(enc.spec, arrays)
+                    assign, n_rounds = rounds_mod.solve_rounds(
+                        enc.spec, rounds_arrays)
                 assign = np.asarray(assign)
                 self.profile["rounds"] = int(n_rounds)
             else:
@@ -335,6 +351,7 @@ class BatchAllocator:
         from volcano_tpu.api.unschedule_info import FitErrors
         from volcano_tpu.scheduler.cache.interface import BindManyError
 
+        prof_t0 = time.perf_counter()
         a = enc.arrays
         t_real = len(enc.task_infos)
         assign = assign[:t_real]
@@ -391,6 +408,8 @@ class BatchAllocator:
         # don't fire mid-apply.
         import gc
 
+        self.profile["apply_prep_s"] = time.perf_counter() - prof_t0
+        prof_t1 = time.perf_counter()
         gc_was = gc.isenabled()
         gc.disable()
         bind_batch = []
@@ -483,6 +502,9 @@ class BatchAllocator:
             if gc_was:
                 gc.enable()
 
+        self.profile["apply_loop_s"] = time.perf_counter() - prof_t1
+        prof_t2 = time.perf_counter()
+
         # --- batch binder + events ----------------------------------------
         binder = cache.binder
         retry_from = None
@@ -511,6 +533,9 @@ class BatchAllocator:
                  f"Successfully assigned "
                  f"{task.namespace}/{task.name} to {host}")
                 for task, host in bind_batch)
+
+        self.profile["apply_bind_s"] = time.perf_counter() - prof_t2
+        prof_t3 = time.perf_counter()
 
         # --- bulk node accounting (session + cache trees) -----------------
         sums_l = sums.tolist()
@@ -579,5 +604,6 @@ class BatchAllocator:
                 "0/%d nodes are available in the batched "
                 "feasibility/fit solve" % n_count)
             job.nodes_fit_errors[task_infos[first].uid] = fe
+        self.profile["apply_post_s"] = time.perf_counter() - prof_t3
 
 

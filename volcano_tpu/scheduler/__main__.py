@@ -170,14 +170,19 @@ def main(argv=None) -> int:
     def on_signal(signum, frame):
         stop_evt.set()
 
+    prev_handlers = {}
     try:
-        signal.signal(signal.SIGINT, on_signal)
-        signal.signal(signal.SIGTERM, on_signal)
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            prev_handlers[sig] = signal.signal(sig, on_signal)
     except ValueError:
         pass  # not the main thread (tests drive main() directly)
 
-    stop_evt.wait(timeout=args.run_for or None)
-    stop_evt.set()
+    try:
+        stop_evt.wait(timeout=args.run_for or None)
+    finally:
+        stop_evt.set()
+        for sig, handler in prev_handlers.items():
+            signal.signal(sig, handler)
 
     if elector is not None:
         elector.stop()

@@ -64,18 +64,22 @@ class ResourceLock:
         self.identity = identity
 
     def get(self) -> Optional[tuple]:
-        """(record, resource_version) or None when the lock doesn't exist."""
+        """(record_or_None, resource_version) when the lock ConfigMap
+        exists — record None means the annotation is missing/unparseable
+        and the caller must take the CAS-update path, NOT create (create
+        would conflict forever and deadlock the election). Returns None
+        only when the ConfigMap itself doesn't exist."""
         cm = self.store.try_get("ConfigMap", self.namespace, self.name)
         if cm is None:
             return None
         raw = (cm.metadata.annotations or {}).get(_RECORD_KEY)
         if not raw:
-            return None
+            return (None, cm.metadata.resource_version)
         try:
             return (LeaderElectionRecord.from_json(raw),
                     cm.metadata.resource_version)
         except (ValueError, TypeError):
-            return None
+            return (None, cm.metadata.resource_version)
 
     def create(self, record: LeaderElectionRecord) -> bool:
         cm = objects.ConfigMap(
@@ -160,9 +164,12 @@ class LeaderElector:
             while not self._stop.is_set():
                 if self._try_acquire_or_renew():
                     if not self._leading:
-                        self._leading = True
                         logger.info("%s became leader", self.lock.identity)
+                        # callback BEFORE publishing is_leader(): an observer
+                        # that polls is_leader() must find the workload
+                        # already started
                         self.on_started_leading()
+                        self._leading = True
                     self._stop.wait(self.retry_period)
                 else:
                     if self._leading:
@@ -200,6 +207,18 @@ class LeaderElector:
             return False  # raced; retry next period
 
         record, version = got
+        if record is None:
+            # lock object exists but carries no readable record (corrupt or
+            # version-skewed annotation): claim it through the CAS update
+            new = LeaderElectionRecord(
+                holder_identity=identity,
+                lease_duration=self.lease_duration,
+                acquire_time=now, renew_time=now)
+            if self.lock.update(new, version):
+                self._observe(identity)
+                return True
+            return False
+
         self._observe(record.holder_identity)
         if record.holder_identity != identity:
             if now < record.renew_time + self.lease_duration:
@@ -227,7 +246,7 @@ class LeaderElector:
         if got is None:
             return
         record, version = got
-        if record.holder_identity != self.lock.identity:
+        if record is None or record.holder_identity != self.lock.identity:
             return
         record.renew_time = 0.0  # expired immediately
         self.lock.update(record, version)

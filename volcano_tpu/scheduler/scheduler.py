@@ -160,14 +160,24 @@ class Scheduler:
             self.cache.stop()
 
     def _loop(self, stop: threading.Event) -> None:
-        while not stop.is_set():
-            start = time.perf_counter()
-            try:
-                self.run_once()
-            except Exception:
-                logger.exception("scheduling cycle failed")
-            elapsed = time.perf_counter() - start
-            stop.wait(max(self.schedule_period - elapsed, 0.0))
+        from volcano_tpu.utils.gcpolicy import LowLatencyGC
+
+        # automatic cyclic GC off while the loop runs: a full-heap scan
+        # landing inside a session costs more than the session (gcpolicy.py);
+        # young-gen collections run between cycles instead
+        policy = LowLatencyGC.install()
+        try:
+            while not stop.is_set():
+                start = time.perf_counter()
+                try:
+                    self.run_once()
+                except Exception:
+                    logger.exception("scheduling cycle failed")
+                policy.maintain()
+                elapsed = time.perf_counter() - start
+                stop.wait(max(self.schedule_period - elapsed, 0.0))
+        finally:
+            policy.uninstall()
 
     # -- one cycle ---------------------------------------------------------
 

@@ -314,20 +314,29 @@ def _queue_budget(enc, queue_alloc, accept, task_rank, task_queue, task_job):
 
 
 @functools.partial(jax.jit, static_argnames=("spec", "layout"))
-def solve_rounds_packed(spec: SolveSpec, layout, f_buf, i_buf, b_buf):
-    """solve_rounds over dtype-packed inputs.
+def solve_rounds_packed(spec: SolveSpec, layout, bufs):
+    """solve_rounds over packed (group x dtype-class) buffers.
 
     The PJRT hop (a tunneled TPU here) pays a fixed RTT per transferred
-    buffer; the encoder emits ~46 arrays, so shipping them individually
-    costs more wall-clock than the solve itself. The solver packs them into
-    one flat buffer per dtype class host-side (solver._pack) and this entry
-    unpacks with static slices — free under XLA fusion."""
-    bufs = {"f": f_buf, "i": i_buf, "b": b_buf}
+    buffer AND per fetch; the encoder emits ~46 arrays, so shipping them
+    individually costs more wall-clock than the solve itself. The solver
+    packs them into flat per-group buffers host-side (solver._pack, with a
+    device cache for unchanged groups) and this entry unpacks with static
+    slices — free under XLA fusion. The result is ONE array — assign plus
+    the round counter packed into trailing limbs — so the host pays exactly
+    one D2H round trip; int16 when the node count allows (halves the
+    downlink; assign values are node indices or -1)."""
     enc = {
-        name: lax.slice_in_dim(bufs[kind], off, off + size).reshape(shape)
-        for name, kind, off, size, shape in layout
+        name: lax.slice_in_dim(bufs[key], off, off + size).reshape(shape)
+        for name, key, off, size, shape in layout
     }
-    return solve_rounds.__wrapped__(spec, enc)
+    assign, n_rounds = solve_rounds.__wrapped__(spec, enc)
+    n_total = enc["node_idle"].shape[0]
+    tail = jnp.stack([n_rounds & 0x7FFF, n_rounds >> 15])
+    if n_total <= 32766:  # static (trace-time) shape decision
+        return jnp.concatenate([assign.astype(jnp.int16),
+                                tail.astype(jnp.int16)])
+    return jnp.concatenate([assign, tail])
 
 
 @functools.partial(jax.jit, static_argnames=("spec",))

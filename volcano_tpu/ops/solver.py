@@ -98,29 +98,90 @@ def pad_encoded(enc: EncodedSnapshot, node_multiple: int = 1) -> Dict[str, np.nd
     return a
 
 
+# change-granularity groups for the packed transfer: arrays in one group
+# share a packed buffer, and an unchanged buffer (byte-compared against the
+# cached host copy) reuses its device-resident twin instead of re-crossing
+# the PJRT hop. Grouping follows churn rate: "dyn" changes every cycle,
+# cluster/template topology groups only when the cluster changes. Unknown
+# names land in "dyn" (always safe — just always re-transferred).
+_GROUP_OF = {}
+for _g, _names in {
+    # only arrays that reach _pack in rounds mode (the _ROUNDS_SKIP per-task
+    # matrices and sampling-window inputs are stripped before packing)
+    "node": ("node_alloc", "node_max_tasks"),
+    "sig": ("sig_mask", "affinity_score"),
+    "cls": ("cls_req", "cls_initreq", "cls_nz_cpu", "cls_nz_mem",
+            "cls_sig", "cls_has_pod"),
+    "task": ("task_cls", "task_job"),
+    "job": ("job_task_start", "job_task_count", "job_queue", "job_ns",
+            "job_priority", "job_min_available", "job_ready_threshold",
+            "job_tie_rank"),
+    "conf": ("eps", "is_scalar", "res_unit", "drf_total", "drf_present",
+             "binpack_w", "binpack_weight", "least_req_weight",
+             "balanced_weight", "node_affinity_weight", "queue_present",
+             "queue_tie_rank", "ns_rank", "ns_weight", "q_in_ns0"),
+}.items():
+    for _n in _names:
+        _GROUP_OF[_n] = _g
+
+# (host_bytes, device_array) per packed-buffer key; process-global because
+# the BatchAllocator is rebuilt each session by the tpuscore plugin while
+# the device buffers outlive sessions. ~[groups x dtype-kinds] entries, each
+# replaced in place when content changes — bounded.
+_DEVICE_CACHE: Dict[str, tuple] = {}
+
+
 def _pack(arrays: Dict[str, np.ndarray]):
-    """Pack the encoder's ~46 arrays into one flat buffer per dtype class
-    (float / int32 / bool). The PJRT transfer path pays a fixed round-trip
-    per buffer — on a tunneled device that fixed cost dwarfs the bytes — so
-    3 transfers beat 46 by hundreds of ms. Returns (layout, bufs) where
-    layout is the static tuple consumed by rounds.solve_rounds_packed."""
-    parts: Dict[str, list] = {"f": [], "i": [], "b": []}
-    offsets = {"f": 0, "i": 0, "b": 0}
+    """Pack arrays into one flat buffer per (group, dtype class). The PJRT
+    transfer path pays a fixed round-trip per buffer — on a tunneled device
+    that fixed cost dwarfs the bytes — so ~15 buffers beat 46, and the
+    grouped layout lets unchanged groups skip the hop entirely via
+    _stage's content-validated device cache. Returns (layout, bufs): layout
+    is the static tuple consumed by rounds.solve_rounds_packed; bufs maps
+    "group.kind" -> flat ndarray."""
+    parts: Dict[str, list] = {}
+    offsets: Dict[str, int] = {}
     layout = []
     for name in sorted(arrays):
         v = np.asarray(arrays[name])
         kind = "f" if v.dtype.kind == "f" else ("b" if v.dtype == np.bool_ else "i")
+        key = _GROUP_OF.get(name, "dyn") + "." + kind
         flat = v.ravel()
-        layout.append((name, kind, offsets[kind], flat.size, v.shape))
-        parts[kind].append(flat)
-        offsets[kind] += flat.size
-    float_dtype = np.result_type(*[p.dtype for p in parts["f"]]) if parts["f"] else np.float32
-    bufs = {
-        "f": np.concatenate(parts["f"]).astype(float_dtype) if parts["f"] else np.zeros(0, np.float32),
-        "i": np.concatenate(parts["i"]).astype(np.int32) if parts["i"] else np.zeros(0, np.int32),
-        "b": np.concatenate(parts["b"]) if parts["b"] else np.zeros(0, bool),
-    }
+        layout.append((name, key, offsets.get(key, 0), flat.size, v.shape))
+        parts.setdefault(key, []).append(flat)
+        offsets[key] = offsets.get(key, 0) + flat.size
+    bufs = {}
+    for key, ps in parts.items():
+        kind = key[-1]
+        if kind == "f":
+            dt = np.result_type(*[p.dtype for p in ps])
+        elif kind == "b":
+            dt = np.bool_
+        else:
+            dt = np.int32
+        bufs[key] = np.concatenate(ps).astype(dt, copy=False)
     return tuple(layout), bufs
+
+
+def _stage(bufs: Dict[str, np.ndarray]) -> Dict[str, object]:
+    """Host buffers -> device arrays, reusing device-resident twins whose
+    bytes are unchanged since the last session (exact np.array_equal against
+    the cached host copy — no hashing, no collisions). Steady-state cycles
+    re-transfer only the buffers that actually changed."""
+    import jax
+
+    staged = {}
+    for key, buf in bufs.items():
+        cached = _DEVICE_CACHE.get(key)
+        if (cached is not None and cached[0].dtype == buf.dtype
+                and cached[0].shape == buf.shape
+                and np.array_equal(cached[0], buf)):
+            staged[key] = cached[1]
+        else:
+            dev = jax.device_put(buf)
+            _DEVICE_CACHE[key] = (buf, dev)
+            staged[key] = dev
+    return staged
 
 
 class BatchAllocator:
@@ -245,12 +306,17 @@ class BatchAllocator:
                 rounds_arrays = {
                     k: v for k, v in arrays.items() if k not in _ROUNDS_SKIP}
                 if self.mesh is None:
-                    # single buffer per dtype: 3 host->device transfers
-                    # instead of ~46 (each pays a fixed tunnel RTT)
+                    # grouped packed transfer + device cache: unchanged
+                    # groups never re-cross the (tunneled) PJRT hop, and the
+                    # solve returns ONE fetchable array (assign + rounds
+                    # limbs) so the session pays a single D2H round trip
                     layout, bufs = _pack(rounds_arrays)
+                    staged = _stage(bufs)
                     tp = time.perf_counter()
-                    assign, n_rounds = rounds_mod.solve_rounds_packed(
-                        enc.spec, layout, bufs["f"], bufs["i"], bufs["b"])
+                    out = np.asarray(rounds_mod.solve_rounds_packed(
+                        enc.spec, layout, staged))
+                    assign = out[:-2].astype(np.int32, copy=False)
+                    n_rounds = int(out[-2]) | (int(out[-1]) << 15)
                     self.profile["pack_s"] = tp - t1
                     self.profile["dispatch_s"] = time.perf_counter() - tp
                 else:

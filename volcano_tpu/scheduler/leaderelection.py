@@ -167,9 +167,13 @@ class LeaderElector:
                         logger.info("%s became leader", self.lock.identity)
                         # callback BEFORE publishing is_leader(): an observer
                         # that polls is_leader() must find the workload
-                        # already started
-                        self.on_started_leading()
-                        self._leading = True
+                        # already started. finally-marking keeps run()'s
+                        # cleanup path releasing the lease even when the
+                        # workload callback raises
+                        try:
+                            self.on_started_leading()
+                        finally:
+                            self._leading = True
                     self._stop.wait(self.retry_period)
                 else:
                     if self._leading:

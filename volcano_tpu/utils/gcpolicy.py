@@ -21,6 +21,7 @@ unless they opt in.
 from __future__ import annotations
 
 import gc
+import threading
 
 
 class LowLatencyGC:
@@ -41,6 +42,7 @@ class LowLatencyGC:
     # the first uninstall re-enable automatic GC under the survivor
     _installs = 0
     _outermost_was_enabled = False
+    _lock = threading.Lock()  # two HA loops may install concurrently
 
     def __init__(self):
         self._cycles = 0
@@ -48,10 +50,11 @@ class LowLatencyGC:
 
     @classmethod
     def install(cls) -> "LowLatencyGC":
-        if cls._installs == 0:
-            cls._outermost_was_enabled = gc.isenabled()
-            gc.disable()
-        cls._installs += 1
+        with cls._lock:
+            if cls._installs == 0:
+                cls._outermost_was_enabled = gc.isenabled()
+                gc.disable()
+            cls._installs += 1
         return cls()
 
     def maintain(self) -> None:
@@ -64,9 +67,10 @@ class LowLatencyGC:
 
     def uninstall(self) -> None:
         cls = type(self)
-        if not self._active:
-            return
-        self._active = False
-        cls._installs -= 1
-        if cls._installs == 0 and cls._outermost_was_enabled:
-            gc.enable()
+        with cls._lock:
+            if not self._active:
+                return
+            self._active = False
+            cls._installs -= 1
+            if cls._installs == 0 and cls._outermost_was_enabled:
+                gc.enable()

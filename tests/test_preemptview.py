@@ -1,0 +1,119 @@
+"""Dense preempt/reclaim view parity (VERDICT r1 weak #4: hybrid-accelerate
+preempt/reclaim; reference pkg/scheduler/actions/preempt/preempt.go:45-260,
+reclaim.go:42-202).
+
+The dense view must be a pure acceleration: identical candidate streams
+(round-robin window + stable score order), identical victim sets, identical
+evictions and pipelined placements as the serial closure sweeps.
+"""
+
+from __future__ import annotations
+
+import volcano_tpu.scheduler.actions  # noqa: F401 (register actions)
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.bench.clusters import build_config
+from volcano_tpu.ops import preemptview
+from volcano_tpu.scheduler.framework import close_session, get_action, open_session
+from volcano_tpu.scheduler.util import scheduler_helper as helper
+
+
+def _run_session(tiers_kind: str, scale: float, actions=("allocate", "backfill", "preempt", "reclaim")):
+    cache, serial_tiers, tpu_tiers, _, _ = build_config(4, scale)
+    tiers = serial_tiers if tiers_kind == "serial" else tpu_tiers
+    ssn = open_session(cache, tiers)
+    for name in actions:
+        get_action(name).execute(ssn)
+    pipelined = {
+        t.uid: t.node_name
+        for job in ssn.jobs.values()
+        for t in job.task_status_index.get(TaskStatus.PIPELINED, {}).values()
+    }
+    bound = dict(cache.binder.binds)
+    evicts = list(cache.evictor.evicts)
+    close_session(ssn)
+    return bound, evicts, pipelined
+
+
+class TestPreemptReclaimParity:
+    def test_full_pipeline_parity_small(self):
+        """Serial vs dense-view session (allocate below the rounds threshold
+        runs serial in both, so preempt/reclaim inputs are identical):
+        bindings, evictions, and pipelined placements must match exactly."""
+        s_bound, s_evicts, s_pipe = _run_session("serial", 0.02)
+        d_bound, d_evicts, d_pipe = _run_session("tpu", 0.02)
+        assert s_bound == d_bound
+        assert s_evicts == d_evicts
+        assert s_pipe == d_pipe
+        assert len(s_evicts) > 0, "config must actually exercise preemption"
+        assert len(s_pipe) > 0
+
+    def test_preemption_actually_triggers_midscale(self):
+        bound, evicts, pipe = _run_session("tpu", 0.05)
+        assert len(evicts) > 0
+        assert len(pipe) > 0
+
+    def test_candidates_match_serial_window_and_order(self):
+        """view.candidates(task) == predicate_nodes + prioritize + sort_nodes
+        for the same rr cursor, task by task."""
+        cache, _, tpu_tiers, _, _ = build_config(4, 0.02)
+        ssn = open_session(cache, tpu_tiers)
+        try:
+            view = preemptview.build(ssn)
+            assert view is not None
+            all_nodes = helper.get_node_list(ssn.nodes)
+            tasks = [
+                t for job in ssn.jobs.values()
+                for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
+                if not t.resreq.is_empty()
+            ][:40]
+            assert tasks
+            for task in tasks:
+                rr0 = helper._last_processed_node_index
+                found, _ = helper.predicate_nodes(task, all_nodes, ssn.predicate_fn)
+                scores = helper.prioritize_nodes(
+                    task, found, ssn.batch_node_order_fn,
+                    ssn.node_order_map_fn, ssn.node_order_reduce_fn)
+                serial_order = [n.name for n in helper.sort_nodes(scores)]
+                rr_serial = helper._last_processed_node_index
+
+                helper._last_processed_node_index = rr0
+                dense = view.candidates(task)
+                assert dense is not None
+                assert [n.name for n in dense] == serial_order
+                assert helper._last_processed_node_index == rr_serial
+        finally:
+            close_session(ssn)
+
+    def test_view_disabled_without_tpuscore(self):
+        cache, serial_tiers, _, _, _ = build_config(4, 0.02)
+        ssn = open_session(cache, serial_tiers)
+        try:
+            assert preemptview.build(ssn) is None
+        finally:
+            close_session(ssn)
+
+    def test_reclaim_masked_nodes_match_serial(self):
+        from volcano_tpu.api.unschedule_info import FitFailure
+
+        cache, _, tpu_tiers, _, _ = build_config(4, 0.02)
+        ssn = open_session(cache, tpu_tiers)
+        try:
+            view = preemptview.build(ssn)
+            tasks = [
+                t for job in ssn.jobs.values()
+                for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
+                if not t.resreq.is_empty()
+            ][:10]
+            for task in tasks:
+                serial = []
+                for node in helper.get_node_list(ssn.nodes):
+                    try:
+                        ssn.predicate_fn(task, node)
+                    except FitFailure:
+                        continue
+                    serial.append(node.name)
+                dense = view.masked_nodes_in_name_order(task)
+                assert dense is not None
+                assert [n.name for n in dense] == serial
+        finally:
+            close_session(ssn)

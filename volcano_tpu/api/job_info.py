@@ -146,6 +146,11 @@ class JobInfo:
 
         self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
         self.tasks: Dict[str, TaskInfo] = {}
+        # status-index mutation counter + ready_task_num memo; code that
+        # mutates task_status_index directly (the bulk apply path) must
+        # bump _status_version
+        self._status_version = 0
+        self._ready_cache = None
 
         self.allocated = Resource.empty()
         self.total_request = Resource.empty()
@@ -184,6 +189,7 @@ class JobInfo:
 
     def _add_task_index(self, ti: TaskInfo) -> None:
         self.task_status_index.setdefault(ti.status, {})[ti.uid] = ti
+        self._status_version += 1
 
     def _delete_task_index(self, ti: TaskInfo) -> None:
         tasks = self.task_status_index.get(ti.status)
@@ -191,6 +197,7 @@ class JobInfo:
             tasks.pop(ti.uid, None)
             if not tasks:
                 del self.task_status_index[ti.status]
+        self._status_version += 1
 
     def add_task_info(self, ti: TaskInfo) -> None:
         self.tasks[ti.uid] = ti
@@ -227,10 +234,16 @@ class JobInfo:
     # -- readiness math ----------------------------------------------------
 
     def ready_task_num(self) -> int:
+        # memoized on the status-index mutation counter: gang gates call
+        # this per candidate visit in the preempt/allocate hot loops
+        cached = self._ready_cache
+        if cached is not None and cached[0] == self._status_version:
+            return cached[1]
         n = 0
         for status, tasks in self.task_status_index.items():
             if allocated_status(status) or status == TaskStatus.SUCCEEDED:
                 n += len(tasks)
+        self._ready_cache = (self._status_version, n)
         return n
 
     def waiting_task_num(self) -> int:

@@ -140,28 +140,75 @@ def _multi_queue(c: SchedulerCache, scale: float) -> int:
 
 
 def _overcommit(c: SchedulerCache, scale: float) -> int:
-    """cfg4: 30k tasks, 8k nodes, ~30% over-committed demand; exercises
-    backfill (zero-request best-effort pods) alongside allocate."""
-    rng = random.Random(4)
-    tasks, nodes = max(int(30000 * scale), 20), max(int(8000 * scale), 4)
-    groups = tasks // 4
-    for g in range(groups):
-        pg = f"job-{g:05d}"
-        c.add_pod_group(build_pod_group(pg, namespace="bench", min_member=1))
-        for i in range(4):
-            if rng.random() < 0.1:  # best-effort: picked up by backfill
-                req: Dict[str, object] = {}
-            else:
-                req = {"cpu": f"{rng.choice([500, 1000, 2000])}m",
-                       "memory": rng.choice(["1Gi", "2Gi"])}
-            c.add_pod(build_pod("bench", f"{pg}-t{i}", "",
-                                objects.POD_PHASE_PENDING, req, pg))
-    # demand ~= 1.3x capacity
+    """cfg4: 30k tasks, 8k nodes, over-committed demand; exercises the full
+    opt-in pipeline: allocate (shortfall), backfill (best-effort pods),
+    preempt (high-priority gangs evicting running low-priority tasks within
+    queue-a), and reclaim (starved queue-b reclaiming queue-a's overage).
+
+    Composition at scale=1 (8k nodes x 4cpu/8Gi = 32k cpu):
+    - 20k RUNNING low-priority 1cpu tasks (queue-a, gangs of 4, min=2):
+      idle = 12k cpu;
+    - 7k PENDING high-priority 2cpu tasks (queue-a, gangs of 4, min=4):
+      14k demand > 12k idle -> allocate places most, preempt evicts
+      low-priority victims for the shortfall;
+    - 1k PENDING queue-b 1cpu tasks: queue-b's deserved share is unmet
+      while queue-a runs over deserved -> reclaim;
+    - 2k best-effort (zero-request) pods -> backfill."""
+    nodes = max(int(8000 * scale), 8)
+    n_running = max(int(20000 * scale) // 4 * 4, 16)
+    n_high = max(int(7000 * scale) // 4 * 4, 8)
+    n_qb = max(int(1000 * scale) // 4 * 4, 4)
+    n_be = max(int(2000 * scale) // 4 * 4, 4)
+
     for n in range(nodes):
         c.add_node(build_node(
             f"node-{n:05d}", build_resource_list_with_pods("4", "8Gi", pods=64)))
-    c.add_queue(build_queue("default"))
-    return groups * 4
+    c.add_queue(build_queue("queue-a", weight=2))
+    c.add_queue(build_queue("queue-b", weight=1))
+
+    # running low-priority fill, bound round-robin (gangs of 4, min=2 so the
+    # gang plugin lets preemption take up to 2 victims per gang)
+    for g in range(n_running // 4):
+        pg = f"run-{g:05d}"
+        c.add_pod_group(build_pod_group(
+            pg, namespace="bench", min_member=2, queue="queue-a"))
+        for i in range(4):
+            idx = g * 4 + i
+            c.add_pod(build_pod(
+                "bench", f"{pg}-t{i}", f"node-{idx % nodes:05d}",
+                objects.POD_PHASE_RUNNING,
+                {"cpu": "1000m", "memory": "1Gi"}, pg, priority=1))
+
+    # pending high-priority gangs (the preemptors)
+    for g in range(n_high // 4):
+        pg = f"hi-{g:05d}"
+        c.add_pod_group(build_pod_group(
+            pg, namespace="bench", min_member=4, queue="queue-a"))
+        for i in range(4):
+            c.add_pod(build_pod(
+                "bench", f"{pg}-t{i}", "", objects.POD_PHASE_PENDING,
+                {"cpu": "2000m", "memory": "2Gi"}, pg, priority=100))
+
+    # starved-queue pending tasks (the reclaimers)
+    for g in range(n_qb // 4):
+        pg = f"qb-{g:05d}"
+        c.add_pod_group(build_pod_group(
+            pg, namespace="bench", min_member=1, queue="queue-b"))
+        for i in range(4):
+            c.add_pod(build_pod(
+                "bench", f"{pg}-t{i}", "", objects.POD_PHASE_PENDING,
+                {"cpu": "1000m", "memory": "1Gi"}, pg, priority=10))
+
+    # best-effort pods for backfill
+    for g in range(n_be // 4):
+        pg = f"be-{g:05d}"
+        c.add_pod_group(build_pod_group(
+            pg, namespace="bench", min_member=1, queue="queue-a"))
+        for i in range(4):
+            c.add_pod(build_pod(
+                "bench", f"{pg}-t{i}", "", objects.POD_PHASE_PENDING,
+                {}, pg, priority=1))
+    return n_running + n_high + n_qb + n_be
 
 
 def _full_default(c: SchedulerCache, scale: float) -> int:
@@ -193,9 +240,9 @@ CONFIGS: Dict[int, BenchConfig] = {
                    _heterogeneous, (["priority", "gang"], ["predicates", "binpack", "proportion"])),
     3: BenchConfig("multi-queue", "allocate+drf+proportion: 10 queues, 20k tasks, 5k nodes",
                    _multi_queue, (["priority", "gang"], ["drf", "proportion"])),
-    4: BenchConfig("overcommit", "allocate+backfill at 30% overcommit: 30k tasks, 8k nodes",
+    4: BenchConfig("overcommit", "allocate+backfill+preempt+reclaim at overcommit: 30k tasks, 8k nodes",
                    _overcommit, (["priority", "gang"], ["drf", "predicates", "proportion", "nodeorder"]),
-                   actions=("allocate", "backfill")),
+                   actions=("allocate", "backfill", "preempt", "reclaim")),
     5: BenchConfig("full-default", "full default conf: 50k tasks x 10k nodes",
                    _full_default, DEFAULT_TIERS),
 }

@@ -1,0 +1,339 @@
+"""Dense (preemptor x node) view for preempt/reclaim acceleration.
+
+The serial preempt/reclaim hot loop (reference
+pkg/scheduler/actions/preempt/preempt.go:180-260, reclaim.go:42-202) pays
+O(nodes) Python predicate closures + O(nodes) score closures PER preemptor
+task before it ever looks at victims. This view batches exactly that part —
+per-signature static feasibility rows and vectorized numpy scoring over the
+same matrices the TPU encoder ships (ops/encoder.py) — while the victim
+selection, Statement evict/pipeline, and commit/rollback authority stay on
+the host, unchanged (SURVEY.md §7 "Preempt/reclaim on TPU": device/batch
+proposes, host commits).
+
+Bit-parity with the serial path is preserved:
+- the round-robin sampling window (scheduler_helper.predicate_nodes) is
+  replicated including its shared cross-action cursor;
+- candidate order is the stable descending-score order of
+  prioritize_nodes + sort_nodes (ties keep circular visit order);
+- scores use the same floor/weight arithmetic as the serial plugins (the
+  formulas fused_scores mirrors, numpy instead of jnp);
+- anything the view does not model (preemptor pod affinity / host ports,
+  resident required anti-affinity symmetry, custom plugins) returns None
+  and the caller runs the serial sweep for that task or session.
+
+State tracking: within preempt/reclaim, node `used`/pod-count change ONLY on
+pipeline (evict flips a task to RELEASING, which keeps `used` and the task
+map entry — node_info.add_task/remove_task), so the actions report
+pipeline/un-pipeline events and the view updates two vectors.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from volcano_tpu.ops import encoder as enc_mod
+from volcano_tpu.scheduler import conf
+from volcano_tpu.scheduler.plugins import nodeorder as nodeorder_mod
+from volcano_tpu.scheduler.plugins import predicates as predicates_mod
+from volcano_tpu.scheduler.util import scheduler_helper as helper
+
+MAX_PRIORITY = nodeorder_mod.MAX_PRIORITY
+
+
+def build(ssn) -> Optional["DensePreemptView"]:
+    """A view over the session, or None when the session uses constructs the
+    dense rows cannot model (the caller then runs fully serial)."""
+    if getattr(ssn, "batch_allocator", None) is None:
+        return None  # tpuscore off => bit-identical serial behavior
+    try:
+        return DensePreemptView(ssn)
+    except _Unsupported:
+        return None
+
+
+class _Unsupported(Exception):
+    pass
+
+
+class DensePreemptView:
+    def __init__(self, ssn):
+        self.ssn = ssn
+
+        # capability gates mirror the encoder's: only the stock predicates /
+        # nodeorder / binpack contribute to the vectorized rows
+        predicates_on = enc_mod._enabled_plugins(
+            ssn, "enabled_predicate", ssn.predicate_fns)
+        if any(p not in enc_mod.SUPPORTED_PREDICATES for p in predicates_on):
+            raise _Unsupported(predicates_on)
+        node_order = enc_mod._enabled_plugins(
+            ssn, "enabled_node_order", ssn.node_order_fns)
+        if any(p not in enc_mod.SUPPORTED_NODE_ORDER for p in node_order):
+            raise _Unsupported(node_order)
+        batch_order = enc_mod._enabled_plugins(
+            ssn, "enabled_node_order", ssn.batch_node_order_fns)
+        if any(p not in ("nodeorder",) for p in batch_order):
+            raise _Unsupported(batch_order)
+        if ssn.node_map_fns or ssn.node_reduce_fns:
+            raise _Unsupported("node map/reduce fns")
+        self.check_pod_count = bool(predicates_on)
+
+        self.node_names = sorted(ssn.nodes)
+        self.nodes: List = [ssn.nodes[n] for n in self.node_names]
+        n = len(self.nodes)
+        self.n = n
+
+        # resident pods with (anti-)affinity make candidate masks/scores
+        # depend on pairwise label matching: anti-affinity symmetry changes
+        # feasibility, and pod_affinity terms feed nodeorder's
+        # InterPodAffinity batch score — both un-modeled here, so the whole
+        # view falls back (the serial predicates/nodeorder path handles
+        # them; rare in preemption scenarios)
+        for node in self.nodes:
+            for t in node.tasks.values():
+                pod = t.pod
+                if pod is not None and pod.spec.affinity is not None and (
+                        pod.spec.affinity.pod_affinity is not None
+                        or pod.spec.affinity.pod_anti_affinity is not None):
+                    raise _Unsupported("resident pod (anti-)affinity")
+
+        # resource axis: cpu/memory + scalars seen on nodes OR requested by
+        # pending tasks — a requested-but-absent scalar must still sit in
+        # the binpack weight sum with zero contribution, exactly like the
+        # serial plugin's capacity-0 dimension (binpack.go:249-261)
+        scalars: set = set()
+        for node in self.nodes:
+            if node.allocatable.scalar_resources:
+                scalars.update(node.allocatable.scalar_resources)
+        from volcano_tpu.api.types import TaskStatus
+
+        for job in ssn.jobs.values():
+            for t in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+                if t.resreq.scalar_resources:
+                    scalars.update(t.resreq.scalar_resources)
+        self.rnames = ["cpu", "memory", *sorted(scalars)]
+        R = len(self.rnames)
+
+        def mat(attr: str) -> np.ndarray:
+            m = np.zeros((n, R), np.float64)
+            ress = [getattr(nd, attr) for nd in self.nodes]
+            m[:, 0] = [r.milli_cpu for r in ress]
+            m[:, 1] = [r.memory for r in ress]
+            for si, rn in enumerate(self.rnames[2:], start=2):
+                m[:, si] = [(r.scalar_resources or {}).get(rn, 0.0) for r in ress]
+            return m
+
+        self.alloc = mat("allocatable")
+        self.used = mat("used")
+        self.cnt = np.array([len(nd.tasks) for nd in self.nodes], np.int64)
+        self.max_tasks = np.array(
+            [nd.allocatable.max_task_num for nd in self.nodes], np.int64)
+
+        # static node predicate parts (conditions/unschedulable/pressure)
+        # with the predicates plugin absent the serial predicate chain is
+        # EMPTY (every node feasible) — selector/taint/condition masking
+        # must then be skipped entirely, not just the pressure checks
+        self.predicates_on = bool(predicates_on)
+        pred_args = enc_mod._plugin_args(ssn, "predicates")
+        memory_p = pred_args.get_bool(predicates_mod.MEMORY_PRESSURE_PREDICATE, False)
+        disk_p = pred_args.get_bool(predicates_mod.DISK_PRESSURE_PREDICATE, False)
+        pid_p = pred_args.get_bool(predicates_mod.PID_PRESSURE_PREDICATE, False)
+        self._node_ok = np.array([
+            enc_mod._static_node_ok(nd, memory_p, disk_p, pid_p)
+            for nd in self.nodes]) if predicates_on else np.ones(n, bool)
+
+        # score weights (same sourcing as the encoder)
+        self.use_nodeorder = "nodeorder" in node_order
+        no_args = enc_mod._plugin_args(ssn, "nodeorder")
+        self.least_req_w = float(no_args.get_int(nodeorder_mod.LEAST_REQUESTED_WEIGHT, 1))
+        self.balanced_w = float(no_args.get_int(nodeorder_mod.BALANCED_RESOURCE_WEIGHT, 1))
+        self.node_aff_w = float(no_args.get_int(nodeorder_mod.NODE_AFFINITY_WEIGHT, 1))
+        self.use_binpack = "binpack" in node_order
+        self.binpack_weight = 0.0
+        self.binpack_w = np.zeros(R, np.float64)
+        if self.use_binpack:
+            bp = ssn.plugins.get("binpack")
+            w = bp.weight
+            if w.binpacking_weight == 0:
+                self.use_binpack = False
+            else:
+                self.binpack_weight = float(w.binpacking_weight)
+                for ri, rn in enumerate(self.rnames):
+                    if rn == "cpu":
+                        self.binpack_w[ri] = w.binpacking_cpu
+                    elif rn == "memory":
+                        self.binpack_w[ri] = w.binpacking_memory
+                    elif rn in w.binpacking_resources:
+                        self.binpack_w[ri] = w.binpacking_resources[rn]
+
+        self._sig_mask: Dict[str, np.ndarray] = {}
+        self._sig_aff: Dict[str, Optional[np.ndarray]] = {}
+        self._node_idx = {name: i for i, name in enumerate(self.node_names)}
+        # pod-count feasibility cached; invalidated only by on_(un)pipeline
+        self._cnt_ok = self.cnt < self.max_tasks
+
+    # -- per-signature static rows ----------------------------------------
+
+    def _rows(self, task) -> Optional[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        pod = task.pod
+        if pod is None:
+            # podless tasks pass the whole predicate chain (predicates.py
+            # early-return); preferred-affinity score is zero
+            ones = self._sig_mask.get("<none>")
+            if ones is None:
+                ones = self._sig_mask["<none>"] = np.ones(self.n, bool)
+                self._sig_aff["<none>"] = None
+            return ones, None
+        key, ports, aff = enc_mod._pod_encode_traits(pod)
+        if ports or aff:
+            return None  # serial fallback for this task
+        mask = self._sig_mask.get(key)
+        if mask is None:
+            if self.predicates_on:
+                row = np.array([
+                    predicates_mod.pod_matches_node_selector(pod, nd)
+                    and predicates_mod.tolerates_taints(pod, nd)
+                    for nd in self.nodes])
+                mask = self._node_ok & row
+            else:
+                mask = np.ones(self.n, bool)
+            self._sig_mask[key] = mask
+            na = pod.spec.affinity.node_affinity if pod.spec.affinity else None
+            if self.use_nodeorder and na is not None and na.preferred_terms:
+                self._sig_aff[key] = np.array([
+                    nodeorder_mod.node_affinity_score(task, nd)
+                    for nd in self.nodes], np.float64)
+            else:
+                self._sig_aff[key] = None
+        return mask, self._sig_aff[key]
+
+    # -- scoring (numpy mirror of kernels.fused_scores) --------------------
+
+    def _scores(self, task, sel: np.ndarray, aff: Optional[np.ndarray]) -> np.ndarray:
+        req = np.zeros(len(self.rnames), np.float64)
+        req[0] = task.resreq.milli_cpu
+        req[1] = task.resreq.memory
+        for si, rn in enumerate(self.rnames[2:], start=2):
+            req[si] = (task.resreq.scalar_resources or {}).get(rn, 0.0)
+        nz_cpu = req[0] if req[0] else nodeorder_mod.DEFAULT_MILLI_CPU_REQUEST
+        nz_mem = req[1] if req[1] else nodeorder_mod.DEFAULT_MEMORY_REQUEST
+
+        alloc = self.alloc[sel]
+        used = self.used[sel]
+        score = np.zeros(len(sel), np.float64)
+        if self.use_nodeorder:
+            cap_cpu, cap_mem = alloc[:, 0], alloc[:, 1]
+            want_cpu = used[:, 0] + nz_cpu
+            want_mem = used[:, 1] + nz_mem
+
+            def dim(cap, want):
+                ok = (cap > 0) & (want <= cap)
+                return np.where(ok, (cap - want) * MAX_PRIORITY
+                                / np.where(cap > 0, cap, 1.0), 0.0)
+
+            least = np.floor((dim(cap_cpu, want_cpu) + dim(cap_mem, want_mem)) / 2.0)
+            cpu_frac = want_cpu / np.where(cap_cpu > 0, cap_cpu, 1.0)
+            mem_frac = want_mem / np.where(cap_mem > 0, cap_mem, 1.0)
+            bal_ok = (cap_cpu > 0) & (cap_mem > 0) & (cpu_frac < 1.0) & (mem_frac < 1.0)
+            balanced = np.where(
+                bal_ok,
+                np.floor(MAX_PRIORITY - np.abs(cpu_frac - mem_frac) * MAX_PRIORITY),
+                0.0)
+            score += least * self.least_req_w + balanced * self.balanced_w
+            if aff is not None:
+                score += aff[sel] * self.node_aff_w
+        if self.use_binpack:
+            w_eff = np.where(req > 0, self.binpack_w, 0.0)
+            w_sum = w_eff.sum()
+            if w_sum > 0:
+                want = req[None, :] + used
+                ok = (alloc > 0) & (want <= alloc)
+                part = np.where(ok, want * w_eff[None, :]
+                                / np.where(alloc > 0, alloc, 1.0), 0.0)
+                score += part.sum(axis=1) / w_sum * MAX_PRIORITY * self.binpack_weight
+        return score
+
+    # -- candidate streams -------------------------------------------------
+
+    def candidates(self, task) -> Optional[List]:
+        """Feasible nodes for `task` in EXACT serial order: the round-robin
+        sampling window of predicate_nodes, then sort_nodes's stable
+        descending-score order. None => caller must run the serial sweep."""
+        rows = self._rows(task)
+        if rows is None:
+            return None
+        mask, aff = rows
+        eligible = mask
+        if self.check_pod_count and task.pod is not None:
+            eligible = eligible & self._cnt_ok
+
+        n = self.n
+        if n == 0:
+            return []
+        num_to_find = helper.calculate_num_of_feasible_nodes_to_find(n)
+        # reduce the shared cross-cycle cursor mod n up front: after a
+        # cluster shrink the raw cursor may exceed n, and predicate_nodes
+        # starts at nodes[cursor % n] — the window and the post-advance
+        # cursor are identical either way (both arithmetics are mod n)
+        rr = helper._last_processed_node_index % n
+        # circular visit order via one nonzero + split at rr (no O(N)
+        # roll/cumsum temporaries — this runs once per preemptor)
+        idx = np.nonzero(eligible)[0]
+        split = int(np.searchsorted(idx, rr))
+        visit = np.concatenate([idx[split:], idx[:split]])
+        found_total = len(visit)
+        if found_total >= num_to_find:
+            sel = visit[:num_to_find]
+            last = int(sel[-1])
+            processed = (last - rr) % n + 1
+        else:
+            sel = visit
+            processed = n
+        helper._last_processed_node_index = (rr + processed) % n
+
+        if len(sel) == 0:
+            return []
+        scores = self._scores(task, sel, aff)
+        order = np.argsort(-scores, kind="stable")
+        return [self.nodes[i] for i in sel[order]]
+
+    def masked_nodes_in_name_order(self, task):
+        """Reclaim/backfill candidate stream: feasible nodes in name order
+        (the serial walks iterate all nodes; no scoring, no sampling
+        window). Returns a LAZY iterator — backfill normally consumes one
+        element, and materializing ~N NodeInfos per task would cost more
+        than the predicate sweep it replaces. None => serial fallback."""
+        rows = self._rows(task)
+        if rows is None:
+            return None
+        eligible = rows[0]
+        if self.check_pod_count and task.pod is not None:
+            eligible = eligible & self._cnt_ok
+        nodes = self.nodes
+        return (nodes[i] for i in np.nonzero(eligible)[0])
+
+    # -- state updates (pipeline is the only op that moves `used`/cnt) -----
+
+    def on_pipeline(self, node_name: str, task) -> None:
+        i = self._node_idx.get(node_name)
+        if i is None:
+            return
+        self.used[i, 0] += task.resreq.milli_cpu
+        self.used[i, 1] += task.resreq.memory
+        for si, rn in enumerate(self.rnames[2:], start=2):
+            self.used[i, si] += (task.resreq.scalar_resources or {}).get(rn, 0.0)
+        self.cnt[i] += 1
+        self._cnt_ok[i] = self.cnt[i] < self.max_tasks[i]
+
+    def on_unpipeline(self, node_name: str, task) -> None:
+        i = self._node_idx.get(node_name)
+        if i is None:
+            return
+        self.used[i, 0] -= task.resreq.milli_cpu
+        self.used[i, 1] -= task.resreq.memory
+        for si, rn in enumerate(self.rnames[2:], start=2):
+            self.used[i, si] -= (task.resreq.scalar_resources or {}).get(rn, 0.0)
+        self.cnt[i] -= 1
+        self._cnt_ok[i] = self.cnt[i] < self.max_tasks[i]

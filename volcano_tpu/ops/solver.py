@@ -486,6 +486,7 @@ class BatchAllocator:
                 lo = hi
                 job = job_infos[ji]
                 cache_job = cache.jobs.get(job.uid)
+                job._status_version += 1  # direct index surgery below
                 idx = job.task_status_index
                 s_pending = idx.get(PENDING)
                 # wholesale bucket move when the whole PENDING set placed
@@ -506,6 +507,7 @@ class BatchAllocator:
                         s_binding = idx[BINDING] = {}
                 if cache_job is not None:
                     c_tasks = cache_job.tasks
+                    cache_job._status_version += 1  # direct index surgery
                     cidx = cache_job.task_status_index
                     c_pending = cidx.get(PENDING)
                     if c_pending is not None and len(c_pending) == len(tis):

@@ -20,6 +20,13 @@ class BackfillAction(Action):
         return "backfill"
 
     def execute(self, ssn) -> None:
+        from volcano_tpu.ops import preemptview
+
+        # dense per-signature feasibility rows (same candidates, same name
+        # order as the serial walk) when tpuscore is on; the predicate
+        # closure sweep remains the fallback and oracle
+        view = preemptview.build(ssn)
+
         all_nodes = helper.get_node_list(ssn.nodes)
         for job in list(ssn.jobs.values()):
             if job.pod_group.status.phase == objects.PodGroupPhase.PENDING:
@@ -33,18 +40,36 @@ class BackfillAction(Action):
                     continue
                 allocated = False
                 fe = FitErrors()
-                for node in all_nodes:
-                    try:
-                        ssn.predicate_fn(task, node)
-                    except FitFailure as err:
-                        fe.set_node_error(node.name, err.fit_error(task, node))
-                        continue
+                candidates = view.masked_nodes_in_name_order(task) \
+                    if view is not None else None
+                if candidates is None:
+                    def _feasible(_task=task, _fe=fe):
+                        for nd in all_nodes:
+                            try:
+                                ssn.predicate_fn(_task, nd)
+                            except FitFailure as err:
+                                _fe.set_node_error(
+                                    nd.name, err.fit_error(_task, nd))
+                                continue
+                            yield nd
+                    candidates = _feasible()
+                tried = 0
+                for node in candidates:
+                    tried += 1
                     try:
                         ssn.allocate(task, node.name)
                     except (KeyError, RuntimeError) as err:
                         logger.error("Failed to bind Task %s on %s: %s", task.uid, node.name, err)
                         continue
+                    if view is not None:
+                        view.on_pipeline(node.name, task)
                     allocated = True
                     break
                 if not allocated:
+                    if view is not None and not fe.nodes:
+                        fe.set_error(
+                            "0/%d nodes are feasible for backfill"
+                            % len(all_nodes) if tried == 0 else
+                            "%d feasible nodes rejected the backfill "
+                            "allocation" % tried)
                     job.nodes_fit_errors[task.uid] = fe

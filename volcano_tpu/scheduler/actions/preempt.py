@@ -27,6 +27,13 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
+        from volcano_tpu.ops import preemptview
+
+        # dense (preemptor x node) feasibility/score rows replace the
+        # serial per-task O(nodes) closure sweeps when tpuscore is on;
+        # victim selection and Statement authority stay here (SURVEY §7)
+        view = preemptview.build(ssn)
+
         preemptors_map: Dict[str, PriorityQueue] = {}
         preemptor_tasks: Dict[str, PriorityQueue] = {}
         under_request: List = []
@@ -62,6 +69,7 @@ class PreemptAction(Action):
 
                 stmt = ssn.statement()
                 assigned = False
+                stmt_pipelines: List = []  # (node_name, task) to unwind
                 while True:
                     if preemptor_tasks[preemptor_job.uid].empty():
                         break
@@ -75,8 +83,13 @@ class PreemptAction(Action):
                             return False
                         return job.queue == _job.queue and _preemptor.job != task.job
 
-                    if _preempt(ssn, stmt, preemptor, ssn.nodes, job_filter):
+                    host = _preempt(ssn, stmt, preemptor, ssn.nodes,
+                                    job_filter, view)
+                    if host is not None:
                         assigned = True
+                        if view is not None:
+                            view.on_pipeline(host, preemptor)
+                            stmt_pipelines.append((host, preemptor))
 
                     if ssn.job_pipelined(preemptor_job):
                         stmt.commit()
@@ -84,6 +97,9 @@ class PreemptAction(Action):
 
                 if not ssn.job_pipelined(preemptor_job):
                     stmt.discard()
+                    if view is not None:
+                        for host, task in stmt_pipelines:
+                            view.on_unpipeline(host, task)
                     continue
 
                 if assigned:
@@ -103,24 +119,35 @@ class PreemptAction(Action):
                         return _preemptor.job == task.job
 
                     stmt = ssn.statement()
-                    assigned = _preempt(ssn, stmt, preemptor, ssn.nodes, task_filter)
+                    host = _preempt(ssn, stmt, preemptor, ssn.nodes,
+                                    task_filter, view)
+                    if host is not None and view is not None:
+                        view.on_pipeline(host, preemptor)
                     stmt.commit()
-                    if not assigned:
+                    if host is None:
                         break
 
 
-def _preempt(ssn, stmt, preemptor, nodes, task_filter) -> bool:
-    """(preempt.go:180-260)"""
-    assigned = False
-    all_nodes = helper.get_node_list(nodes)
-    found_nodes, _ = helper.predicate_nodes(preemptor, all_nodes, ssn.predicate_fn)
-    node_scores = helper.prioritize_nodes(
-        preemptor, found_nodes,
-        ssn.batch_node_order_fn, ssn.node_order_map_fn, ssn.node_order_reduce_fn)
+def _preempt(ssn, stmt, preemptor, nodes, task_filter, view=None):
+    """(preempt.go:180-260). Returns the pipelined node name, or None.
 
-    for node in helper.sort_nodes(node_scores):
+    With a dense view the candidate stream (feasibility window + score
+    order) comes from vectorized rows; victim selection below is identical
+    either way."""
+    candidates = view.candidates(preemptor) if view is not None else None
+    if candidates is None:  # no view, or un-modeled preemptor (ports/affinity)
+        all_nodes = helper.get_node_list(nodes)
+        found_nodes, _ = helper.predicate_nodes(preemptor, all_nodes, ssn.predicate_fn)
+        node_scores = helper.prioritize_nodes(
+            preemptor, found_nodes,
+            ssn.batch_node_order_fn, ssn.node_order_map_fn, ssn.node_order_reduce_fn)
+        candidates = helper.sort_nodes(node_scores)
+
+    for node in candidates:
+        # shared_clone: victims need independent status words for the
+        # evict bookkeeping but never mutate their request Resources
         preemptees = [
-            task.clone()
+            task.shared_clone()
             for task in node.tasks.values()
             if task_filter is None or task_filter(task)
         ]
@@ -154,10 +181,9 @@ def _preempt(ssn, stmt, preemptor, nodes, task_filter) -> bool:
 
         if preemptor.init_resreq.less_equal(preempted):
             stmt.pipeline(preemptor, node.name)
-            assigned = True
-            break
+            return node.name
 
-    return assigned
+    return None
 
 
 def _validate_victims(victims, resreq) -> bool:

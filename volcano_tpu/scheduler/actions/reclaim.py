@@ -28,6 +28,13 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn) -> None:
+        from volcano_tpu.ops import preemptview
+
+        # dense per-signature feasibility rows replace the per-task O(nodes)
+        # predicate closure sweep when tpuscore is on (same candidates, name
+        # order, as reclaim.go's full node walk); victim selection unchanged
+        view = preemptview.build(ssn)
+
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_set = set()
         preemptors_map: Dict[str, PriorityQueue] = {}
@@ -69,12 +76,20 @@ class ReclaimAction(Action):
             task = tasks.pop()
 
             assigned = False
-            for node in helper.get_node_list(ssn.nodes):
-                try:
-                    ssn.predicate_fn(task, node)
-                except FitFailure:
-                    continue
-
+            candidates = view.masked_nodes_in_name_order(task) \
+                if view is not None else None
+            if candidates is None:
+                def _serial_feasible(_task=task):
+                    # lazy, like the original walk: predicates run only up
+                    # to the node that succeeds
+                    for nd in helper.get_node_list(ssn.nodes):
+                        try:
+                            ssn.predicate_fn(_task, nd)
+                        except FitFailure:
+                            continue
+                        yield nd
+                candidates = _serial_feasible()
+            for node in candidates:
                 resreq = task.init_resreq.clone()
                 reclaimed = Resource.empty()
 
@@ -86,7 +101,7 @@ class ReclaimAction(Action):
                     if j is None:
                         continue
                     if j.queue != job.queue:
-                        reclaimees.append(t.clone())
+                        reclaimees.append(t.shared_clone())
                 victims = ssn.reclaimable(task, reclaimees)
                 if not victims:
                     continue
@@ -110,6 +125,8 @@ class ReclaimAction(Action):
 
                 if task.init_resreq.less_equal(reclaimed):
                     ssn.pipeline(task, node.name)
+                    if view is not None:
+                        view.on_pipeline(node.name, task)
                     assigned = True
                     break
 

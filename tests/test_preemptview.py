@@ -84,6 +84,24 @@ class TestPreemptReclaimParity:
         finally:
             close_session(ssn)
 
+    def test_poison_retires_view_after_fallback_placement(self):
+        """A serially-placed un-modeled pod (affinity/ports) makes cached
+        masks stale; poison() must force serial for the rest of the action."""
+        cache, _, tpu_tiers, _, _ = build_config(4, 0.02)
+        ssn = open_session(cache, tpu_tiers)
+        try:
+            view = preemptview.build(ssn)
+            task = next(
+                t for job in ssn.jobs.values()
+                for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
+                if not t.resreq.is_empty())
+            assert view.candidates(task) is not None
+            view.poison()
+            assert view.candidates(task) is None
+            assert view.masked_nodes_in_name_order(task) is None
+        finally:
+            close_session(ssn)
+
     def test_view_disabled_without_tpuscore(self):
         cache, serial_tiers, _, _, _ = build_config(4, 0.02)
         ssn = open_session(cache, serial_tiers)

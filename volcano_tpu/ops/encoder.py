@@ -412,18 +412,22 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
     t_count = len(task_infos)
     s_count = max(len(sig_rep), 1)
 
-    # column-wise fills: ~10x faster than per-task _resource_vec at 50k tasks
+    # column-wise fills: ~10x faster than per-task _resource_vec at 50k
+    # tasks; the Resource objects are hoisted once so each column pays one
+    # attribute chain, not two
     task_req = np.zeros((t_count, R), np.float64)
     task_initreq = np.zeros((t_count, R), np.float64)
-    task_req[:, 0] = [t.resreq.milli_cpu for t in task_infos]
-    task_req[:, 1] = [t.resreq.memory for t in task_infos]
-    task_initreq[:, 0] = [t.init_resreq.milli_cpu for t in task_infos]
-    task_initreq[:, 1] = [t.init_resreq.memory for t in task_infos]
+    reqs = [t.resreq for t in task_infos]
+    initreqs = [t.init_resreq for t in task_infos]
+    task_req[:, 0] = [r.milli_cpu for r in reqs]
+    task_req[:, 1] = [r.memory for r in reqs]
+    task_initreq[:, 0] = [r.milli_cpu for r in initreqs]
+    task_initreq[:, 1] = [r.memory for r in initreqs]
     for si, rn in enumerate(rnames[2:], start=2):
         task_req[:, si] = [
-            (t.resreq.scalar_resources or {}).get(rn, 0.0) for t in task_infos]
+            (r.scalar_resources or {}).get(rn, 0.0) for r in reqs]
         task_initreq[:, si] = [
-            (t.init_resreq.scalar_resources or {}).get(rn, 0.0) for t in task_infos]
+            (r.scalar_resources or {}).get(rn, 0.0) for r in initreqs]
     task_nz_cpu = np.where(task_req[:, 0] != 0, task_req[:, 0],
                            nodeorder_mod.DEFAULT_MILLI_CPU_REQUEST)
     task_nz_mem = np.where(task_req[:, 1] != 0, task_req[:, 1],

@@ -173,10 +173,21 @@ class DensePreemptView:
         self._node_idx = {name: i for i, name in enumerate(self.node_names)}
         # pod-count feasibility cached; invalidated only by on_(un)pipeline
         self._cnt_ok = self.cnt < self.max_tasks
+        self._poisoned = False
+
+    def poison(self) -> None:
+        """A task the view cannot model (pod (anti-)affinity / host ports)
+        was PLACED by the serial fallback mid-action: resident-affinity
+        state now affects every later task's feasibility/score (the
+        predicates plugin tracks it via allocate events), so the view
+        retires and the rest of the action runs fully serial."""
+        self._poisoned = True
 
     # -- per-signature static rows ----------------------------------------
 
     def _rows(self, task) -> Optional[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        if self._poisoned:
+            return None
         pod = task.pod
         if pod is None:
             # podless tasks pass the whole predicate chain (predicates.py

@@ -535,13 +535,15 @@ class BatchAllocator:
                     if s_pending is not None:
                         s_pending.pop(uid, None)
                         s_binding[uid] = task
-                    # one BINDING-status clone shared by the session and
-                    # cache node maps — both trees only read it for
-                    # accounting and predicate checks, and it is never
-                    # status-flipped in place
-                    clone = task.shared_clone()
+                    # the session task itself is shared into both node
+                    # task-maps (the serial path stores clones so LATER
+                    # status flips can't corrupt node accounting; nothing
+                    # flips a BINDING task in place for the rest of this
+                    # session, and cache watch events REPLACE node entries
+                    # rather than mutate them, so the share is safe and
+                    # saves one object per placement)
                     key = task.namespace + "/" + task.name
-                    ssn_nodes[host].tasks[key] = clone
+                    ssn_nodes[host].tasks[key] = task
                     if c_tasks is not None:
                         ctask = c_tasks.get(uid)
                         if ctask is not None:
@@ -552,7 +554,7 @@ class BatchAllocator:
                                 c_binding[uid] = ctask
                             cnode = cache_nodes.get(host)
                             if cnode is not None:
-                                cnode.tasks[key] = clone
+                                cnode.tasks[key] = task
                     # effector contract matches session.dispatch ->
                     # cache.bind (cache.py:374-395): volumes, then binder
                     if not vols_noop:

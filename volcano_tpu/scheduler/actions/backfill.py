@@ -42,7 +42,8 @@ class BackfillAction(Action):
                 fe = FitErrors()
                 candidates = view.masked_nodes_in_name_order(task) \
                     if view is not None else None
-                if candidates is None:
+                fell_back = candidates is None
+                if fell_back:
                     def _feasible(_task=task, _fe=fe):
                         for nd in all_nodes:
                             try:
@@ -62,7 +63,12 @@ class BackfillAction(Action):
                         logger.error("Failed to bind Task %s on %s: %s", task.uid, node.name, err)
                         continue
                     if view is not None:
-                        view.on_pipeline(node.name, task)
+                        if fell_back:
+                            # an un-modeled (affinity/ports) pod became
+                            # resident: later masks/scores would be stale
+                            view.poison()
+                        else:
+                            view.on_pipeline(node.name, task)
                     allocated = True
                     break
                 if not allocated:

@@ -135,7 +135,8 @@ def _preempt(ssn, stmt, preemptor, nodes, task_filter, view=None):
     order) comes from vectorized rows; victim selection below is identical
     either way."""
     candidates = view.candidates(preemptor) if view is not None else None
-    if candidates is None:  # no view, or un-modeled preemptor (ports/affinity)
+    fell_back = candidates is None
+    if fell_back:  # no view, or un-modeled preemptor (ports/affinity)
         all_nodes = helper.get_node_list(nodes)
         found_nodes, _ = helper.predicate_nodes(preemptor, all_nodes, ssn.predicate_fn)
         node_scores = helper.prioritize_nodes(
@@ -181,6 +182,10 @@ def _preempt(ssn, stmt, preemptor, nodes, task_filter, view=None):
 
         if preemptor.init_resreq.less_equal(preempted):
             stmt.pipeline(preemptor, node.name)
+            if fell_back and view is not None:
+                # a pod the view cannot model just became resident — its
+                # (anti-)affinity now affects every later mask/score
+                view.poison()
             return node.name
 
     return None

@@ -78,7 +78,8 @@ class ReclaimAction(Action):
             assigned = False
             candidates = view.masked_nodes_in_name_order(task) \
                 if view is not None else None
-            if candidates is None:
+            fell_back = candidates is None
+            if fell_back:
                 def _serial_feasible(_task=task):
                     # lazy, like the original walk: predicates run only up
                     # to the node that succeeds
@@ -126,7 +127,11 @@ class ReclaimAction(Action):
                 if task.init_resreq.less_equal(reclaimed):
                     ssn.pipeline(task, node.name)
                     if view is not None:
-                        view.on_pipeline(node.name, task)
+                        if fell_back:
+                            # un-modeled pod became resident (see preempt)
+                            view.poison()
+                        else:
+                            view.on_pipeline(node.name, task)
                     assigned = True
                     break
 

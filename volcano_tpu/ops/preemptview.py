@@ -176,12 +176,24 @@ class DensePreemptView:
         self._poisoned = False
 
     def poison(self) -> None:
-        """A task the view cannot model (pod (anti-)affinity / host ports)
-        was PLACED by the serial fallback mid-action: resident-affinity
-        state now affects every later task's feasibility/score (the
-        predicates plugin tracks it via allocate events), so the view
-        retires and the rest of the action runs fully serial."""
+        """A pod with (anti-)affinity was PLACED by the serial fallback
+        mid-action: resident-affinity state now affects every later task's
+        feasibility/score (the predicates plugin tracks it via allocate
+        events), so the view retires and the rest of the action runs fully
+        serial. Callers gate on needs_poison — a resident host-ports-only
+        pod constrains only ports-carrying candidates, which already fall
+        back serially."""
         self._poisoned = True
+
+    @staticmethod
+    def needs_poison(task) -> bool:
+        """True when placing `task` invalidates cached masks/scores for
+        OTHER tasks (it carries pod (anti-)affinity terms)."""
+        pod = task.pod
+        if pod is None or pod.spec.affinity is None:
+            return False
+        aff = pod.spec.affinity
+        return aff.pod_affinity is not None or aff.pod_anti_affinity is not None
 
     # -- per-signature static rows ----------------------------------------
 

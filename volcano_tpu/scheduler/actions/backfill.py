@@ -63,12 +63,11 @@ class BackfillAction(Action):
                         logger.error("Failed to bind Task %s on %s: %s", task.uid, node.name, err)
                         continue
                     if view is not None:
-                        if fell_back:
-                            # an un-modeled (affinity/ports) pod became
-                            # resident: later masks/scores would be stale
+                        view.on_pipeline(node.name, task)
+                        if fell_back and view.needs_poison(task):
+                            # an affinity-carrying pod became resident:
+                            # later masks/scores would be stale
                             view.poison()
-                        else:
-                            view.on_pipeline(node.name, task)
                     allocated = True
                     break
                 if not allocated:

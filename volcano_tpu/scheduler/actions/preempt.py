@@ -93,9 +93,16 @@ class PreemptAction(Action):
 
                     if ssn.job_pipelined(preemptor_job):
                         stmt.commit()
+                        # an affinity-carrying pod became resident for real
+                        # (committed): cached masks/scores are stale now
+                        if view is not None and any(
+                                view.needs_poison(t) for _, t in stmt_pipelines):
+                            view.poison()
                         break
 
                 if not ssn.job_pipelined(preemptor_job):
+                    # discard restores the cluster exactly — no poison, the
+                    # un-modeled pod never became resident
                     stmt.discard()
                     if view is not None:
                         for host, task in stmt_pipelines:
@@ -123,6 +130,8 @@ class PreemptAction(Action):
                                     task_filter, view)
                     if host is not None and view is not None:
                         view.on_pipeline(host, preemptor)
+                        if view.needs_poison(preemptor):
+                            view.poison()
                     stmt.commit()
                     if host is None:
                         break
@@ -182,10 +191,6 @@ def _preempt(ssn, stmt, preemptor, nodes, task_filter, view=None):
 
         if preemptor.init_resreq.less_equal(preempted):
             stmt.pipeline(preemptor, node.name)
-            if fell_back and view is not None:
-                # a pod the view cannot model just became resident — its
-                # (anti-)affinity now affects every later mask/score
-                view.poison()
             return node.name
 
     return None

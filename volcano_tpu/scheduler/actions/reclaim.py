@@ -127,11 +127,10 @@ class ReclaimAction(Action):
                 if task.init_resreq.less_equal(reclaimed):
                     ssn.pipeline(task, node.name)
                     if view is not None:
-                        if fell_back:
-                            # un-modeled pod became resident (see preempt)
+                        view.on_pipeline(node.name, task)
+                        if fell_back and view.needs_poison(task):
+                            # affinity pod became resident (see preempt)
                             view.poison()
-                        else:
-                            view.on_pipeline(node.name, task)
                     assigned = True
                     break
 

@@ -40,6 +40,10 @@ class TaskInfo:
         "priority",
         "volume_ready",
         "pod",
+        # columnar-mirror coordinates (scheduler/cache/podtable.py): the
+        # cache assigns them; clones inherit; (row, row_gen) validate reads
+        "row",
+        "row_gen",
     )
 
     def __init__(
@@ -67,9 +71,11 @@ class TaskInfo:
         self.priority = priority
         self.volume_ready = volume_ready
         self.pod = pod
+        self.row = -1
+        self.row_gen = -1
 
     def clone(self) -> "TaskInfo":
-        return TaskInfo(
+        t = TaskInfo(
             uid=self.uid,
             job=self.job,
             name=self.name,
@@ -82,6 +88,9 @@ class TaskInfo:
             volume_ready=self.volume_ready,
             pod=self.pod,
         )
+        t.row = self.row
+        t.row_gen = self.row_gen
+        return t
 
     def shared_clone(self) -> "TaskInfo":
         """Status-frozen copy for node task-maps that SHARES the resreq /
@@ -101,6 +110,8 @@ class TaskInfo:
         t.priority = self.priority
         t.volume_ready = self.volume_ready
         t.pod = self.pod
+        t.row = self.row
+        t.row_gen = self.row_gen
         return t
 
     def __repr__(self) -> str:

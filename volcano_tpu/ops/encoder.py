@@ -110,70 +110,14 @@ class EncodedSnapshot:
         )
 
 
-def _signature_key(pod: Optional[objects.Pod]) -> str:
-    if pod is None:
-        return "<none>"
-    spec = pod.spec
-    if not spec.node_selector and spec.affinity is None and not spec.tolerations:
-        return "<plain>"
-    parts = [repr(sorted(spec.node_selector.items()))]
-    aff = spec.affinity
-    if aff is not None and aff.node_affinity is not None:
-        parts.append(repr([_term_repr(t) for t in aff.node_affinity.required_terms]))
-        parts.append(
-            repr([(p.weight, _term_repr(p.preference)) for p in aff.node_affinity.preferred_terms])
-        )
-    parts.append(repr([(t.key, t.operator, t.value, t.effect) for t in spec.tolerations]))
-    return "|".join(parts)
-
-
-def _pod_encode_traits(pod: objects.Pod):
-    """(signature key, has_host_ports, has_pod_affinity), cached on the pod.
-
-    Pod objects persist across sessions (snapshot clones TaskInfos but
-    shares the pod reference), so caching amortizes the per-task
-    string/scan work of the encoder's hot loop to one computation per pod
-    *version*: the store bumps metadata.resource_version on every
-    create/update (store.py:121-136), including in-place mutations
-    re-stored by effectors, so the cache is keyed on it and recomputes
-    whenever the pod changed."""
-    rv = pod.metadata.resource_version
-    try:
-        cached_rv, traits = pod._enc_traits
-        if cached_rv == rv:
-            return traits
-    except AttributeError:
-        pass
-    traits = (
-        _signature_key(pod),
-        _has_host_ports(pod),
-        _has_pod_affinity(pod),
-    )
-    pod._enc_traits = (rv, traits)
-    return traits
-
-
-def _term_repr(term) -> str:
-    return repr(getattr(term, "match_expressions", term))
-
-
-def _has_pod_affinity(pod: Optional[objects.Pod]) -> bool:
-    if pod is None or pod.spec.affinity is None:
-        return False
-    a = pod.spec.affinity
-    return a.pod_affinity is not None or a.pod_anti_affinity is not None
-
-
-def _has_host_ports(pod: Optional[objects.Pod]) -> bool:
-    if pod is None:
-        return False
-    # plain loops: this runs per fresh pod in the encoder hot path and a
-    # genexpr-under-any costs ~3x the common no-ports case
-    for c in pod.spec.containers:
-        for p in c.ports:
-            if p.host_port > 0:
-                return True
-    return False
+# trait helpers live in api/pod_traits.py (shared with the cache's columnar
+# pod table); aliased here for the existing call sites
+from volcano_tpu.api.pod_traits import (  # noqa: E402
+    has_host_ports as _has_host_ports,
+    has_pod_affinity as _has_pod_affinity,
+    pod_encode_traits as _pod_encode_traits,
+    signature_key as _signature_key,
+)
 
 
 def _static_node_ok(node: NodeInfo, memory_p: bool, disk_p: bool, pid_p: bool) -> bool:
@@ -196,6 +140,108 @@ def _static_node_ok(node: NodeInfo, memory_p: bool, disk_p: bool, pid_p: bool) -
 
 def _resource_vec(res: Resource, names: List[str]) -> np.ndarray:
     return np.array([res.get(n) for n in names], np.float64)
+
+
+def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue):
+    """Columnar task axis: validated gathers from the cache's pod table
+    instead of walking task objects. Returns the tuple encode_session
+    unpacks, or None to fall back (stale rows, rowless tasks).
+
+    Semantics match the object walk exactly: same (job, -priority, ctime,
+    uid) order, same residue rules, same per-job contiguity; only the
+    session-signature NUMBERING differs (table-id order instead of
+    first-encounter order), which nothing downstream depends on."""
+    from volcano_tpu.scheduler.cache.podtable import (
+        FLAG_AFFINITY, FLAG_PORTS, FLAG_REQ_EMPTY)
+
+    all_tasks: List[TaskInfo] = []
+    job_of: List[int] = []
+    for ji, job in enumerate(jobs):
+        pend = job.task_status_index.get(TaskStatus.PENDING)
+        if not pend:
+            continue
+        for t in pend.values():
+            all_tasks.append(t)
+            job_of.append(ji)
+    p_count = len(all_tasks)
+    if p_count == 0:
+        return None  # legacy handles the empty axis trivially
+
+    rows = np.fromiter((t.row for t in all_tasks), np.int64, p_count)
+    if rows.min() < 0:
+        return None  # task(s) without table rows (podless) — object walk
+    gens = np.fromiter((t.row_gen for t in all_tasks), np.int64, p_count)
+
+    scalar_set = set(table.scalar_names())
+    for node in nodes:
+        if node.allocatable.scalar_resources:
+            scalar_set.update(node.allocatable.scalar_resources)
+    rnames = ["cpu", "memory", *sorted(scalar_set)]
+    R = len(rnames)
+
+    g = table.gather(rows, gens, rnames[2:])
+    if g is None:
+        return None  # rows went stale between snapshot and encode
+
+    flags = g["flags"]
+    nonempty = (flags & FLAG_REQ_EMPTY) == 0
+    sub = np.nonzero(nonempty)[0] if not nonempty.all() \
+        else np.arange(p_count)
+    if sub.size == 0:
+        return None
+    job_of_arr = np.asarray(job_of, np.int64)
+    uid = np.array([t.uid for t in all_tasks])
+    prio = g["priority"] if prio_on else np.zeros(p_count, np.int64)
+    order = np.lexsort(
+        (uid[sub], g["ctime"][sub], -prio[sub], job_of_arr[sub]))
+    sel = sub[order]  # indices into all_tasks, job-major sorted
+
+    residue = ((flags & (FLAG_PORTS | FLAG_AFFINITY)) != 0)[sel]
+    if residue.any():
+        if not allow_residue:
+            # match the object walk's error specificity
+            first = sel[np.argmax(residue)]
+            if flags[first] & FLAG_AFFINITY:
+                raise EncoderFallback("pod (anti-)affinity not modeled")
+            raise EncoderFallback("host ports not modeled")
+        keep = sel[~residue]
+        job_residue = np.bincount(
+            job_of_arr[sel[residue]], minlength=j_count).astype(np.int32)
+    else:
+        keep = sel
+        job_residue = np.zeros(j_count, np.int32)
+
+    task_infos = [all_tasks[i] for i in keep]
+    t_count = len(task_infos)
+
+    # session signature ids from table-global ids (numbering differs from
+    # the object walk's first-encounter order; content is identical)
+    tsig = g["sig_id"][keep]
+    uniq, first_idx, task_sig_arr = np.unique(
+        tsig, return_index=True, return_inverse=True)
+    task_sig_arr = task_sig_arr.astype(np.int32)
+    sig_rep = [task_infos[i] for i in first_idx]
+
+    task_req = np.zeros((t_count, R), np.float64)
+    task_initreq = np.zeros((t_count, R), np.float64)
+    task_req[:, 0] = g["cpu"][keep]
+    task_req[:, 1] = g["mem"][keep]
+    task_initreq[:, 0] = g["init_cpu"][keep]
+    task_initreq[:, 1] = g["init_mem"][keep]
+    for si, rn in enumerate(rnames[2:], start=2):
+        task_req[:, si] = g["scalars"][rn][keep]
+        task_initreq[:, si] = g["init_scalars"][rn][keep]
+
+    kept_jobs = job_of_arr[keep]
+    job_task_count = np.bincount(kept_jobs, minlength=j_count).astype(np.int32)
+    # kept tasks are job-major contiguous, so starts are the prefix sums
+    job_task_start = np.zeros(j_count, np.int32)
+    if j_count:
+        np.cumsum(job_task_count[:-1], out=job_task_start[1:])
+
+    return (rnames, task_infos, sig_rep, task_sig_arr,
+            job_task_start, job_task_count, job_residue,
+            task_req, task_initreq)
 
 
 def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
@@ -289,19 +335,163 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
         jobs.append(job)
     j_count = len(jobs)
 
-    # resource dimensionality: cpu, memory + every scalar seen
-    scalar_names: set = set()
-    for job in jobs:
-        for task in job.tasks.values():
-            if task.resreq.scalar_resources:
-                scalar_names.update(task.resreq.scalar_resources)
-            if task.init_resreq.scalar_resources:
-                scalar_names.update(task.init_resreq.scalar_resources)
-    for node in nodes:
-        if node.allocatable.scalar_resources:
-            scalar_names.update(node.allocatable.scalar_resources)
-    rnames = ["cpu", "memory", *sorted(scalar_names)]
-    R = len(rnames)
+    # with live anti-affinity symmetry terms, mask membership depends on a
+    # pod's labels AND namespace (selector matching) — extend the signature
+    # key so all pods sharing a signature also share symmetry verdicts
+    # (otherwise an unlabeled representative could unmask labeled pods, or
+    # vice versa)
+    sym_active = bool(sym_terms)
+    task_order_plugins = set(
+        _enabled_plugins(ssn, "enabled_task_order", ssn.task_order_fns))
+
+    # ---- flat task axis ----------------------------------------------------
+    # fast path: the cache's columnar pod table (podtable.py) already holds
+    # requests/priority/ctime/traits/signatures per pod — the whole task
+    # axis becomes validated numpy gathers. Falls back to the object walk
+    # when rows went stale, tasks lack rows, symmetry terms are live, or a
+    # custom task-order plugin needs its comparator.
+    table = getattr(getattr(ssn, "cache", None), "pod_table", None)
+    fast = None
+    if table is not None and not sym_active and task_order_plugins <= {"priority"}:
+        fast = _fast_task_axis(
+            jobs, j_count, nodes, table, bool(task_order_plugins), allow_residue)
+
+    if fast is not None:
+        (rnames, task_infos, sig_rep, task_sig_arr,
+         job_task_start, job_task_count, job_residue,
+         task_req, task_initreq) = fast
+        R = len(rnames)
+        t_count = len(task_infos)
+        s_count = max(len(sig_rep), 1)
+        task_has_pod = np.ones(t_count, bool)
+    else:
+        # resource dimensionality: cpu, memory + every scalar seen
+        scalar_names: set = set()
+        for job in jobs:
+            for task in job.tasks.values():
+                if task.resreq.scalar_resources:
+                    scalar_names.update(task.resreq.scalar_resources)
+                if task.init_resreq.scalar_resources:
+                    scalar_names.update(task.init_resreq.scalar_resources)
+        for node in nodes:
+            if node.allocatable.scalar_resources:
+                scalar_names.update(node.allocatable.scalar_resources)
+        rnames = ["cpu", "memory", *sorted(scalar_names)]
+        R = len(rnames)
+
+        task_infos = []
+        job_task_start = np.zeros(j_count, np.int32)
+        job_task_count = np.zeros(j_count, np.int32)
+        sig_index: Dict[str, int] = {}
+        sig_rep = []
+        task_sig: List[int] = []
+
+        def order_key(a: TaskInfo, b: TaskInfo) -> int:
+            return -1 if ssn.task_order_fn(a, b) else (1 if ssn.task_order_fn(b, a) else 0)
+
+        # gather every job's pending tasks-with-requests (job-major, so each
+        # job's block is contiguous after the job-primary sort below)
+        all_tasks: List[TaskInfo] = []
+        job_of: List[int] = []
+        for ji, job in enumerate(jobs):
+            pend = job.task_status_index.get(TaskStatus.PENDING)
+            if not pend:
+                continue
+            for t in pend.values():
+                if not t.resreq.is_empty():
+                    all_tasks.append(t)
+                    job_of.append(ji)
+        p_count = len(all_tasks)
+
+        # the priority plugin is the only stock task-order fn; its
+        # comparator is exactly this key tuple (priority.py:20-24 + the
+        # session creation/uid tie-break), so ONE C-level lexsort replaces
+        # J per-job comparator sorts
+        if p_count == 0:
+            order: List[int] = []
+        elif task_order_plugins <= {"priority"}:
+            prio = (np.fromiter((t.priority for t in all_tasks), np.int64, p_count)
+                    if task_order_plugins else np.zeros(p_count, np.int64))
+            ctime = np.fromiter(
+                ((t.pod.metadata.creation_timestamp if t.pod is not None else 0.0)
+                 for t in all_tasks), np.float64, p_count)
+            uid = np.array([t.uid for t in all_tasks])
+            order = np.lexsort(
+                (uid, ctime, -prio, np.asarray(job_of, np.int64))).tolist()
+        else:
+            # custom task-order fns: per-job comparator sort (job blocks
+            # are contiguous in job_of by construction)
+            order = []
+            lo = 0
+            while lo < p_count:
+                hi = lo
+                while hi < p_count and job_of[hi] == job_of[lo]:
+                    hi += 1
+                idxs = sorted(range(lo, hi),
+                              key=cmp_to_key(
+                                  lambda x, y: order_key(all_tasks[x], all_tasks[y])))
+                order.extend(idxs)
+                lo = hi
+
+        job_residue = np.zeros(j_count, np.int32)
+        cur_ji = -1
+        for oi in order:
+            t = all_tasks[oi]
+            ji = job_of[oi]
+            if ji != cur_ji:
+                if cur_ji >= 0:
+                    job_task_count[cur_ji] = len(task_infos) - int(job_task_start[cur_ji])
+                job_task_start[ji] = len(task_infos)
+                cur_ji = ji
+            if t.pod is None:
+                key = "<none>"
+            else:
+                key, ports, aff = _pod_encode_traits(t.pod)
+                if aff:
+                    if not allow_residue:
+                        raise EncoderFallback("pod (anti-)affinity not modeled")
+                    job_residue[ji] += 1
+                    continue
+                if ports:
+                    if not allow_residue:
+                        raise EncoderFallback("host ports not modeled")
+                    job_residue[ji] += 1
+                    continue
+                if sym_active:
+                    key = (f"{key}|labels={sorted(t.pod.metadata.labels.items())!r}"
+                           f"|ns={t.pod.metadata.namespace}")
+            si = sig_index.get(key)
+            if si is None:
+                si = sig_index[key] = len(sig_rep)
+                sig_rep.append(t)
+            task_sig.append(si)
+            task_infos.append(t)
+        if cur_ji >= 0:
+            job_task_count[cur_ji] = len(task_infos) - int(job_task_start[cur_ji])
+        t_count = len(task_infos)
+        s_count = max(len(sig_rep), 1)
+
+        # column-wise fills: ~10x faster than per-task _resource_vec at 50k
+        # tasks; the Resource objects are hoisted once so each column pays
+        # one attribute chain, not two
+        task_req = np.zeros((t_count, R), np.float64)
+        task_initreq = np.zeros((t_count, R), np.float64)
+        reqs = [t.resreq for t in task_infos]
+        initreqs = [t.init_resreq for t in task_infos]
+        task_req[:, 0] = [r.milli_cpu for r in reqs]
+        task_req[:, 1] = [r.memory for r in reqs]
+        task_initreq[:, 0] = [r.milli_cpu for r in initreqs]
+        task_initreq[:, 1] = [r.memory for r in initreqs]
+        for si, rn in enumerate(rnames[2:], start=2):
+            task_req[:, si] = [
+                (r.scalar_resources or {}).get(rn, 0.0) for r in reqs]
+            task_initreq[:, si] = [
+                (r.scalar_resources or {}).get(rn, 0.0) for r in initreqs]
+        task_has_pod = np.array([t.pod is not None for t in task_infos], bool) \
+            if task_infos else np.zeros(0, bool)
+        task_sig_arr = (np.array(task_sig, np.int32)
+                        if task_sig else np.zeros(0, np.int32))
+
     eps = np.array(
         [MIN_MILLI_CPU, MIN_MEMORY] + [MIN_MILLI_SCALAR] * (R - 2), np.float64
     )
@@ -309,131 +499,10 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
     # integer quantization units for the rounds solver's exact cumsums:
     # milli-cpu, MiB, milli-scalar (eps/res_unit == 10 in every dim)
     res_unit = np.array([1.0, 1024.0 * 1024.0] + [1.0] * (R - 2), np.float64)
-
-    # ---- flat task axis ----------------------------------------------------
-    task_infos: List[TaskInfo] = []
-    job_task_start = np.zeros(j_count, np.int32)
-    job_task_count = np.zeros(j_count, np.int32)
-    sig_index: Dict[str, int] = {}
-    sig_rep: List[TaskInfo] = []
-    task_sig: List[int] = []
-
-    def order_key(a: TaskInfo, b: TaskInfo) -> int:
-        return -1 if ssn.task_order_fn(a, b) else (1 if ssn.task_order_fn(b, a) else 0)
-
-    # gather every job's pending tasks-with-requests (job-major, so each
-    # job's block is contiguous after the job-primary sort below)
-    all_tasks: List[TaskInfo] = []
-    job_of: List[int] = []
-    for ji, job in enumerate(jobs):
-        pend = job.task_status_index.get(TaskStatus.PENDING)
-        if not pend:
-            continue
-        for t in pend.values():
-            if not t.resreq.is_empty():
-                all_tasks.append(t)
-                job_of.append(ji)
-    p_count = len(all_tasks)
-
-    # fast path: the priority plugin is the only stock task-order fn; its
-    # comparator is exactly this key tuple (priority.py:20-24 + the session
-    # creation/uid tie-break), so ONE C-level lexsort over all pending tasks
-    # replaces J Python comparator sorts (the encoder's former hot spot)
-    task_order_plugins = set(
-        _enabled_plugins(ssn, "enabled_task_order", ssn.task_order_fns))
-    if p_count == 0:
-        order: List[int] = []
-    elif task_order_plugins <= {"priority"}:
-        prio = (np.fromiter((t.priority for t in all_tasks), np.int64, p_count)
-                if task_order_plugins else np.zeros(p_count, np.int64))
-        ctime = np.fromiter(
-            ((t.pod.metadata.creation_timestamp if t.pod is not None else 0.0)
-             for t in all_tasks), np.float64, p_count)
-        uid = np.array([t.uid for t in all_tasks])
-        order = np.lexsort(
-            (uid, ctime, -prio, np.asarray(job_of, np.int64))).tolist()
-    else:
-        # custom task-order fns: per-job comparator sort (job blocks are
-        # contiguous in job_of by construction)
-        order = []
-        lo = 0
-        while lo < p_count:
-            hi = lo
-            while hi < p_count and job_of[hi] == job_of[lo]:
-                hi += 1
-            idxs = sorted(range(lo, hi),
-                          key=cmp_to_key(
-                              lambda x, y: order_key(all_tasks[x], all_tasks[y])))
-            order.extend(idxs)
-            lo = hi
-
-    # with live anti-affinity symmetry terms, mask membership depends on a
-    # pod's labels AND namespace (selector matching) — extend the signature
-    # key so all pods sharing a signature also share symmetry verdicts
-    # (otherwise an unlabeled representative could unmask labeled pods, or
-    # vice versa)
-    sym_active = bool(sym_terms)
-
-    job_residue = np.zeros(j_count, np.int32)
-    cur_ji = -1
-    for oi in order:
-        t = all_tasks[oi]
-        ji = job_of[oi]
-        if ji != cur_ji:
-            if cur_ji >= 0:
-                job_task_count[cur_ji] = len(task_infos) - int(job_task_start[cur_ji])
-            job_task_start[ji] = len(task_infos)
-            cur_ji = ji
-        if t.pod is None:
-            key = "<none>"
-        else:
-            key, ports, aff = _pod_encode_traits(t.pod)
-            if aff:
-                if not allow_residue:
-                    raise EncoderFallback("pod (anti-)affinity not modeled")
-                job_residue[ji] += 1
-                continue
-            if ports:
-                if not allow_residue:
-                    raise EncoderFallback("host ports not modeled")
-                job_residue[ji] += 1
-                continue
-            if sym_active:
-                key = (f"{key}|labels={sorted(t.pod.metadata.labels.items())!r}"
-                       f"|ns={t.pod.metadata.namespace}")
-        si = sig_index.get(key)
-        if si is None:
-            si = sig_index[key] = len(sig_rep)
-            sig_rep.append(t)
-        task_sig.append(si)
-        task_infos.append(t)
-    if cur_ji >= 0:
-        job_task_count[cur_ji] = len(task_infos) - int(job_task_start[cur_ji])
-    t_count = len(task_infos)
-    s_count = max(len(sig_rep), 1)
-
-    # column-wise fills: ~10x faster than per-task _resource_vec at 50k
-    # tasks; the Resource objects are hoisted once so each column pays one
-    # attribute chain, not two
-    task_req = np.zeros((t_count, R), np.float64)
-    task_initreq = np.zeros((t_count, R), np.float64)
-    reqs = [t.resreq for t in task_infos]
-    initreqs = [t.init_resreq for t in task_infos]
-    task_req[:, 0] = [r.milli_cpu for r in reqs]
-    task_req[:, 1] = [r.memory for r in reqs]
-    task_initreq[:, 0] = [r.milli_cpu for r in initreqs]
-    task_initreq[:, 1] = [r.memory for r in initreqs]
-    for si, rn in enumerate(rnames[2:], start=2):
-        task_req[:, si] = [
-            (r.scalar_resources or {}).get(rn, 0.0) for r in reqs]
-        task_initreq[:, si] = [
-            (r.scalar_resources or {}).get(rn, 0.0) for r in initreqs]
     task_nz_cpu = np.where(task_req[:, 0] != 0, task_req[:, 0],
                            nodeorder_mod.DEFAULT_MILLI_CPU_REQUEST)
     task_nz_mem = np.where(task_req[:, 1] != 0, task_req[:, 1],
                            nodeorder_mod.DEFAULT_MEMORY_REQUEST)
-    task_has_pod = np.array([t.pod is not None for t in task_infos], bool) \
-        if task_infos else np.zeros(0, bool)
 
     # ---- task equivalence classes ------------------------------------------
     # tasks stamped from one template share (req, initreq, signature,
@@ -441,8 +510,6 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
     # rounds sweep; deduping collapses the (T x N) sweep to (K x N) with
     # K ~ #templates << T (the TPU-native analog of the reference's
     # per-template predicate work, equivalence classes instead of sampling)
-    task_sig_arr = (np.array(task_sig, np.int32)
-                    if task_sig else np.zeros(0, np.int32))
     if t_count:
         cls_key = np.ascontiguousarray(np.concatenate(
             [task_req, task_initreq,

@@ -189,11 +189,9 @@ class DensePreemptView:
     def needs_poison(task) -> bool:
         """True when placing `task` invalidates cached masks/scores for
         OTHER tasks (it carries pod (anti-)affinity terms)."""
-        pod = task.pod
-        if pod is None or pod.spec.affinity is None:
-            return False
-        aff = pod.spec.affinity
-        return aff.pod_affinity is not None or aff.pod_anti_affinity is not None
+        from volcano_tpu.api.pod_traits import has_pod_affinity
+
+        return has_pod_affinity(task.pod)
 
     # -- per-signature static rows ----------------------------------------
 

@@ -70,6 +70,7 @@ class PreemptAction(Action):
                 stmt = ssn.statement()
                 assigned = False
                 stmt_pipelines: List = []  # (node_name, task) to unwind
+                poison0 = view._poisoned if view is not None else False
                 while True:
                     if preemptor_tasks[preemptor_job.uid].empty():
                         break
@@ -93,20 +94,17 @@ class PreemptAction(Action):
 
                     if ssn.job_pipelined(preemptor_job):
                         stmt.commit()
-                        # an affinity-carrying pod became resident for real
-                        # (committed): cached masks/scores are stale now
-                        if view is not None and any(
-                                view.needs_poison(t) for _, t in stmt_pipelines):
-                            view.poison()
                         break
 
                 if not ssn.job_pipelined(preemptor_job):
-                    # discard restores the cluster exactly — no poison, the
-                    # un-modeled pod never became resident
+                    # discard restores the cluster exactly — including any
+                    # poison raised by THIS statement's fallback pipelines
+                    # (the un-modeled pod is resident no longer)
                     stmt.discard()
                     if view is not None:
                         for host, task in stmt_pipelines:
                             view.on_unpipeline(host, task)
+                        view._poisoned = poison0
                     continue
 
                 if assigned:
@@ -130,8 +128,6 @@ class PreemptAction(Action):
                                     task_filter, view)
                     if host is not None and view is not None:
                         view.on_pipeline(host, preemptor)
-                        if view.needs_poison(preemptor):
-                            view.poison()
                     stmt.commit()
                     if host is None:
                         break
@@ -191,6 +187,12 @@ def _preempt(ssn, stmt, preemptor, nodes, task_filter, view=None):
 
         if preemptor.init_resreq.less_equal(preempted):
             stmt.pipeline(preemptor, node.name)
+            if fell_back and view is not None and view.needs_poison(preemptor):
+                # pipeline fires allocate events IMMEDIATELY (statement.py),
+                # so this pod's (anti-)affinity is resident right now and
+                # cached masks are stale for the very next candidate; the
+                # action restores the pre-statement poison state on discard
+                view.poison()
             return node.name
 
     return None

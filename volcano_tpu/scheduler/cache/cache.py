@@ -135,6 +135,9 @@ class SchedulerCache:
         )
         self.volume_binder = volume_binder if volume_binder is not None else DefaultVolumeBinder()
 
+        from volcano_tpu.scheduler.cache.podtable import PodTable
+
+        self.pod_table = PodTable()
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
         self.queues: Dict[str, QueueInfo] = {}
@@ -180,6 +183,10 @@ class SchedulerCache:
         job = self._get_or_create_job(ti)
         if job is not None:
             job.add_task_info(ti)
+        if ti.pod is not None:
+            # columnar mirror row (podtable.py): the encoder gathers dense
+            # arrays instead of walking 50k task objects per session
+            self.pod_table.add(ti.pod, ti)
         if ti.node_name:
             if ti.node_name not in self.nodes:
                 self.nodes[ti.node_name] = NodeInfo(None)
@@ -187,6 +194,7 @@ class SchedulerCache:
                 self.nodes[ti.node_name].add_task(ti)
 
     def _delete_task(self, ti: TaskInfo) -> None:
+        self.pod_table.remove(ti.uid)
         errs = []
         if ti.job:
             job = self.jobs.get(ti.job)

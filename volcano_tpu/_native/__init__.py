@@ -1,0 +1,92 @@
+"""Native runtime components, compiled lazily at first use.
+
+The control plane is Python with the solve on TPU; the few remaining
+interpreted hot loops (the bulk-apply writeback) have native equivalents
+here, compiled on demand with the system toolchain into this package
+directory and imported like any extension module. Every native path has a
+pure-Python fallback — a missing compiler, failed build, or failed import
+degrades to the oracle implementation, never to an error.
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_FASTAPPLY = None
+_TRIED = False
+_BUILD_THREAD = None
+
+
+def _build(src: str, modname: str) -> bool:
+    ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(_DIR, modname + ext)
+    src_path = os.path.join(_DIR, src)
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src_path):
+        return True
+    cc = sysconfig.get_config_var("CC") or "cc"
+    include = sysconfig.get_paths()["include"]
+    cmd = [*cc.split(), "-O2", "-fPIC", "-shared",
+           f"-I{include}", src_path, "-o", out + ".tmp"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except Exception as e:  # toolchain absent / sandboxed
+        logger.info("native build unavailable (%s); using Python fallback", e)
+        return False
+    if proc.returncode != 0:
+        logger.warning("native build failed; using Python fallback:\n%s",
+                       proc.stderr[-2000:])
+        return False
+    os.replace(out + ".tmp", out)
+    return True
+
+
+def get_fastapply():
+    """The compiled _fastapply module, or None (callers keep the Python
+    loop). Build+import attempted once per process. BLOCKS on the compiler
+    the first time — latency-critical callers use get_fastapply_nowait."""
+    global _FASTAPPLY, _TRIED
+    if _TRIED:
+        return _FASTAPPLY
+    _TRIED = True
+    if os.environ.get("VOLCANO_TPU_NO_NATIVE"):
+        return None
+    try:
+        if _build("fastapply.c", "_fastapply"):
+            if _DIR not in sys.path:
+                sys.path.insert(0, _DIR)
+            _FASTAPPLY = importlib.import_module("_fastapply")
+    except Exception:
+        logger.exception("native fastapply unavailable; using Python fallback")
+        _FASTAPPLY = None
+    return _FASTAPPLY
+
+
+def get_fastapply_nowait():
+    """Non-blocking variant for the apply critical path: returns the module
+    if it is already available (cached .so imports in milliseconds), else
+    kicks the compile off on a background thread ONCE and returns None —
+    the first session runs the Python fallback instead of waiting on cc."""
+    global _BUILD_THREAD
+    if _TRIED:
+        return _FASTAPPLY
+    if os.environ.get("VOLCANO_TPU_NO_NATIVE"):
+        return None
+    ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(_DIR, "_fastapply" + ext)
+    src = os.path.join(_DIR, "fastapply.c")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return get_fastapply()  # import only — no compiler run
+    if _BUILD_THREAD is None:
+        import threading
+
+        _BUILD_THREAD = threading.Thread(target=get_fastapply, daemon=True)
+        _BUILD_THREAD.start()
+    return None

@@ -185,6 +185,16 @@ class DensePreemptView:
         back serially."""
         self._poisoned = True
 
+    def poison_state(self) -> bool:
+        """Opaque snapshot for restore_poison (statement-scoped save)."""
+        return self._poisoned
+
+    def restore_poison(self, state: bool) -> None:
+        """Statement discard: un-does any poison raised inside the
+        statement (the un-modeled pod is resident no longer). Kept as a
+        method so future poison side effects restore in one place."""
+        self._poisoned = state
+
     @staticmethod
     def needs_poison(task) -> bool:
         """True when placing `task` invalidates cached masks/scores for

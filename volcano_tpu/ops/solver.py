@@ -478,7 +478,20 @@ class BatchAllocator:
         prof_t1 = time.perf_counter()
         gc_was = gc.isenabled()
         gc.disable()
-        bind_batch = []
+        bind_tasks: list = []
+        bind_hosts: list = []
+        # native inner loop (volcano_tpu/_native/fastapply.c): identical
+        # semantics to the Python body below, which remains the fallback
+        # and oracle; volumes force the Python path (effector calls)
+        fast = None
+        if vols_noop:
+            # non-blocking: a cold process compiles on a background thread
+            # and THIS session runs the Python loop; never wait on cc here
+            from volcano_tpu._native import get_fastapply_nowait
+
+            mod = get_fastapply_nowait()
+            if mod is not None:
+                fast = mod.apply_job_tasks
         try:
             lo = 0
             for ji, hi in zip(job_nz, seg_ends):
@@ -526,41 +539,48 @@ class BatchAllocator:
                 else:
                     c_tasks = c_pending = c_binding = None
 
-                for ti in tis:
-                    task = task_infos[ti]
-                    host = node_names[assign_l[ti]]
-                    task.node_name = host
-                    task.status = BINDING
-                    uid = task.uid
-                    if s_pending is not None:
-                        s_pending.pop(uid, None)
-                        s_binding[uid] = task
-                    # the session task itself is shared into both node
-                    # task-maps (the serial path stores clones so LATER
-                    # status flips can't corrupt node accounting; nothing
-                    # flips a BINDING task in place for the rest of this
-                    # session, and cache watch events REPLACE node entries
-                    # rather than mutate them, so the share is safe and
-                    # saves one object per placement)
-                    key = task.namespace + "/" + task.name
-                    ssn_nodes[host].tasks[key] = task
-                    if c_tasks is not None:
-                        ctask = c_tasks.get(uid)
-                        if ctask is not None:
-                            ctask.node_name = host
-                            ctask.status = BINDING
-                            if c_pending is not None:
-                                c_pending.pop(uid, None)
-                                c_binding[uid] = ctask
-                            cnode = cache_nodes.get(host)
-                            if cnode is not None:
-                                cnode.tasks[key] = task
-                    # effector contract matches session.dispatch ->
-                    # cache.bind (cache.py:374-395): volumes, then binder
-                    if not vols_noop:
-                        alloc_vols(task, host)
-                        bind_vols(task)
-                    bind_batch.append((task, host))
+                if fast is not None:
+                    fast(tis, task_infos, assign_l, node_names, BINDING,
+                         s_pending, s_binding, c_tasks, c_pending, c_binding,
+                         ssn_nodes, cache_nodes, bind_tasks, bind_hosts)
+                else:
+                    for ti in tis:
+                        task = task_infos[ti]
+                        host = node_names[assign_l[ti]]
+                        task.node_name = host
+                        task.status = BINDING
+                        uid = task.uid
+                        if s_pending is not None:
+                            s_pending.pop(uid, None)
+                            s_binding[uid] = task
+                        # the session task itself is shared into both node
+                        # task-maps (the serial path stores clones so LATER
+                        # status flips can't corrupt node accounting;
+                        # nothing flips a BINDING task in place for the
+                        # rest of this session, and cache watch events
+                        # REPLACE node entries rather than mutate them, so
+                        # the share is safe and saves one object per
+                        # placement)
+                        key = task.namespace + "/" + task.name
+                        ssn_nodes[host].tasks[key] = task
+                        if c_tasks is not None:
+                            ctask = c_tasks.get(uid)
+                            if ctask is not None:
+                                ctask.node_name = host
+                                ctask.status = BINDING
+                                if c_pending is not None:
+                                    c_pending.pop(uid, None)
+                                    c_binding[uid] = ctask
+                                cnode = cache_nodes.get(host)
+                                if cnode is not None:
+                                    cnode.tasks[key] = task
+                        # effector contract matches session.dispatch ->
+                        # cache.bind (cache.py:374-395): volumes, binder
+                        if not vols_noop:
+                            alloc_vols(task, host)
+                            bind_vols(task)
+                        bind_tasks.append(task)
+                        bind_hosts.append(host)
 
                 # PENDING -> BINDING leaves total_request unchanged;
                 # allocated grows by the job's placed sum
@@ -580,7 +600,8 @@ class BatchAllocator:
         retry_from = None
         if hasattr(binder, "bind_many"):
             try:
-                binder.bind_many([(t.pod, h) for t, h in bind_batch])
+                binder.bind_many(
+                    [(t.pod, h) for t, h in zip(bind_tasks, bind_hosts)])
             except BindManyError as e:
                 retry_from = e.done
             except Exception:
@@ -592,7 +613,8 @@ class BatchAllocator:
         if retry_from is not None:
             # per-task so one bad pod degrades to resync, not a lost
             # session (cache.go:597-599 semantics)
-            for task, host in bind_batch[retry_from:]:
+            for task, host in zip(bind_tasks[retry_from:],
+                                  bind_hosts[retry_from:]):
                 try:
                     binder.bind(task.pod, host)
                 except Exception:
@@ -602,7 +624,7 @@ class BatchAllocator:
                 (task.pod, "Normal", "Scheduled",
                  f"Successfully assigned "
                  f"{task.namespace}/{task.name} to {host}")
-                for task, host in bind_batch)
+                for task, host in zip(bind_tasks, bind_hosts))
 
         self.profile["apply_bind_s"] = time.perf_counter() - prof_t2
         prof_t3 = time.perf_counter()

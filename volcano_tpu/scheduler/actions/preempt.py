@@ -70,7 +70,7 @@ class PreemptAction(Action):
                 stmt = ssn.statement()
                 assigned = False
                 stmt_pipelines: List = []  # (node_name, task) to unwind
-                poison0 = view._poisoned if view is not None else False
+                poison0 = view.poison_state() if view is not None else False
                 while True:
                     if preemptor_tasks[preemptor_job.uid].empty():
                         break
@@ -104,7 +104,7 @@ class PreemptAction(Action):
                     if view is not None:
                         for host, task in stmt_pipelines:
                             view.on_unpipeline(host, task)
-                        view._poisoned = poison0
+                        view.restore_poison(poison0)
                     continue
 
                 if assigned:

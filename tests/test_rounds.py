@@ -462,3 +462,46 @@ class TestRoundsResidue:
         binds = cache.binder.binds
         assert len(binds) == 2, binds
         assert binds["ns1/pgw-p0"] == "node-001", binds
+
+
+class TestPolicyShape:
+    """Bulk-synchronous placement must still express each scoring policy's
+    intent: spreading policies distribute across tied nodes, packing
+    policies consolidate (rounds._choices capacity walk + tie rotation)."""
+
+    def _populate(self, c):
+        c.add_queue(build_queue("default"))
+        for n in range(6):
+            c.add_node(build_node(
+                f"n{n:02d}", build_resource_list_with_pods("16", "32Gi", pods=64)))
+        for g in range(6):
+            pg = f"pg{g}"
+            c.add_pod_group(build_pod_group(pg, namespace="d", min_member=4))
+            for i in range(4):
+                c.add_pod(build_pod("d", f"{pg}-{i}", "", objects.POD_PHASE_PENDING,
+                                    {"cpu": "1", "memory": "1Gi"}, pg))
+
+    @staticmethod
+    def _per_node(cache):
+        per = {}
+        for _, node in cache.binder.binds.items():
+            per[node] = per.get(node, 0) + 1
+        return per
+
+    def test_least_requested_spreads_across_tied_nodes(self):
+        cache, _ = run_rounds(
+            self._populate,
+            tiers=(["priority", "gang"],
+                   ["drf", "predicates", "proportion", "nodeorder"]))
+        per = self._per_node(cache)
+        assert sum(per.values()) == 24
+        assert len(per) == 6, per  # every identical node used
+
+    def test_binpack_consolidates(self):
+        cache, _ = run_rounds(
+            self._populate,
+            tiers=(["priority", "gang"],
+                   ["drf", "predicates", "proportion", "binpack"]))
+        per = self._per_node(cache)
+        assert sum(per.values()) == 24
+        assert len(per) <= 3, per  # fill node by node, not spread

@@ -9,13 +9,18 @@ score all pending tasks, commit gang blocks, re-score deltas on device):
 Round (all on device, one jitted while_loop):
 1. job-order keys -> job rank (lexsort over J), task rank = (job rank, task
    order); tasks in overused queues sit the round out (proportion.go:201).
-2. chunked (T x N) fused feasibility ∧ epsilon-fit ∧ pod-count masks and
-   binpack+nodeorder scores -> per-task best node (argmax, lowest-index
-   tie-break = smallest node name).
+2. (K x N) fused feasibility ∧ epsilon-fit ∧ pod-count masks and
+   binpack+nodeorder scores over task equivalence CLASSES (K ~ #templates
+   << T); each class's feasible nodes are ordered by descending score and
+   the class's i-th active task takes the node where i falls in cumulative
+   estimated capacity — rotated within equal-score groups for spreading
+   policies, sequential (packing) when binpack is on, with per-class
+   demand-share apportioning so contending classes don't all over-claim
+   the same nodes.
 3. conflict resolution: sort tasks by (chosen node, task rank); per-node
    *prefix acceptance* — the longest priority-prefix whose cumulative request
    fits idle (cumsum ≤ idle + eps reproduces the serial per-step epsilon
-   exactly) and pod slots.
+   exactly) and pod slots; capacity estimates in step 2 are advisory only.
 4. scatter-commit: idle/used/pod-count, job/queue/namespace allocation.
 Rounds repeat while any task lands. Then a gang-rollback pass retires the
 worst-ranked job still short of min_available (statement.go Discard
@@ -74,16 +79,22 @@ def _job_rank(spec: SolveSpec, enc, job_placed, job_alloc):
 
 
 def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
-    """Per-task best feasible node via task equivalence classes.
+    """Per-task node choice via task equivalence classes.
 
     Tasks stamped from one template share (req, initreq, signature,
     has_pod) — encoder.task_cls — and therefore produce IDENTICAL masked
     score rows, so the sweep is (K x N) over classes with K ~ #templates
-    << T, then per-task gathers pick the (t mod n_tied)-th tied-best node.
-    Output is identical to a per-task (T x N) sweep: the tie-spreading key
-    was already the flat task index (divergence from the serial min-name
-    tie-break, see module doc), and everything else in a task's row is a
-    pure function of its class.
+    << T. Each class's feasible nodes are ordered by descending score
+    (stable: ascending node index on ties) with a per-node capacity
+    ESTIMATE; the class's i-th active task takes the node where i falls in
+    cumulative capacity — so one round can saturate many nodes, not just
+    each class's argmax. Within equal-score groups the assignment rotates
+    (spreading policies' serial behavior on tied nodes) unless binpack is
+    enabled (packing fills node by node). Estimates are advisory:
+    _resolve's exact prefix acceptance enforces real feasibility, and the
+    optimistic tail retries next round. A task can therefore land on a
+    lower-scoring node than its class argmax within a round (documented
+    round-granularity divergence, see module doc).
 
     Returns (choice [T] int32, -1 when nothing feasible/inactive)."""
     k_total = enc["cls_req"].shape[0]
@@ -92,10 +103,19 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
     is_scalar = enc["is_scalar"]
     neg = jnp.array(-jnp.inf, idle.dtype)
     task_cls = enc["task_cls"]
+    t_cap = task_cls.shape[0] + 1  # capacity clamp: ranks never reach it
 
     # a class is live iff any of its tasks is still active; dead-class
     # chunks skip the (chunk x N) sweep (late rounds: most classes placed)
     cls_live = jnp.zeros(k_total, bool).at[task_cls].max(active)
+    # per-class active demand, for the binpack capacity apportioning: with
+    # a packing policy every class walks the SAME node order, so each must
+    # claim only its demand share of a node's estimated capacity or the
+    # round over-commits the first nodes K-fold and convergence crawls
+    cls_demand = jnp.zeros(k_total, jnp.int32).at[task_cls].add(
+        active.astype(jnp.int32))
+    cls_frac = cls_demand.astype(idle.dtype) / jnp.maximum(
+        jnp.sum(cls_demand), 1).astype(idle.dtype)
 
     chunk = min(CHUNK, k_total)  # both powers of two (solver buckets)
     n_chunks = k_total // chunk
@@ -123,41 +143,130 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
 
             score = fused_scores(spec, enc, used, req, nz_cpu, nz_mem, sig)
             masked = jnp.where(mask, score, neg)
-            # deterministic tie spreading: scores are coarse (floor-based),
-            # so whole gangs tie on one node and would fill the cluster one
-            # node per round; enumerate each class's tied-best nodes so task
-            # t can take the (t mod n_tied)-th — exact-tie-only, score order
-            # untouched
-            m = jnp.max(masked, axis=-1, keepdims=True)
-            tied = (masked == m) & mask                       # [C, N]
-            n_tied = jnp.sum(tied, axis=-1).astype(jnp.int32)  # [C]
-            csum = jnp.cumsum(tied.astype(jnp.int32), axis=-1)
-            # tied_list[k, j] = node index of the (j+1)-th tied node; the
-            # extra trailing column absorbs the non-tied scatters
-            pos = jnp.where(tied, csum - 1, n_total)
-            cols = jnp.broadcast_to(
-                jnp.arange(n_total, dtype=jnp.int32)[None, :], (chunk, n_total))
-            rows = jnp.broadcast_to(
-                jnp.arange(chunk)[:, None], (chunk, n_total))
-            tied_list = jnp.zeros((chunk, n_total + 1), jnp.int32) \
-                .at[rows, pos].set(cols)
-            return tied_list[:, :n_total], n_tied
+            # capacity-aware spreading: rank the class's feasible nodes by
+            # descending score (stable => ascending node index on ties, the
+            # serial tie-break), estimate how many of THIS class each node
+            # can hold, and hand the class's i-th task a node where i falls
+            # in cumulative capacity — INTERLEAVED across equal-score
+            # groups. Why both mechanisms: score-concentrating policies
+            # (binpack) would otherwise send every task of a class to the
+            # one best node and the bulk-synchronous round fills a single
+            # node's prefix (measured: 89 rounds at cfg2), while spreading
+            # policies (least-requested) tie whole groups of nodes whose
+            # serial behavior is round-robin; the capacity walk handles the
+            # former, the within-group rotation the latter. _resolve's
+            # exact prefix acceptance cleans up the optimistic tail.
+            order = jnp.argsort(-masked, axis=-1, stable=True)  # [C, N]
+            # per-(class, node) capacity estimate from per-dim idle/req
+            # (advisory only — real feasibility stays with _resolve)
+            safe_req = jnp.maximum(req, eps[None, :])
+            cap_dim = idle[None, :, :] / safe_req[:, None, :]   # [C, N, R]
+            cap = jnp.min(
+                jnp.where((req > 0)[:, None, :], cap_dim, jnp.inf), axis=-1)
+            big = jnp.asarray(float(t_cap), idle.dtype)
+            cap = jnp.minimum(jnp.where(jnp.isinf(cap), big, cap), big)
+            if spec.use_binpack:
+                frac = lax.dynamic_slice_in_dim(cls_frac, sl, chunk)
+                cap = cap * frac[:, None]
+            if spec.check_pod_count:
+                pod_room = (enc["node_max_tasks"] - cnt)[None, :].astype(cap.dtype)
+                cap = jnp.where(has_pod[:, None],
+                                jnp.minimum(cap, pod_room), cap)
+            cap = jnp.where(mask, jnp.floor(cap), 0.0)
+            cap = jnp.maximum(cap, jnp.where(mask, 1.0, 0.0))  # >=1 if feasible
+            cap_i = cap.astype(jnp.int32)
+            # SATURATING prefix sum at t_cap (> any rank): a plain int32
+            # cumsum can wrap at N*(T+1); saturating add of non-negatives
+            # is associative, so the scan stays exact and monotone with
+            # every partial <= 2*t_cap
+            ccap = lax.associative_scan(
+                lambda a, b: jnp.minimum(a + b, jnp.int32(t_cap)),
+                jnp.take_along_axis(cap_i, order, axis=-1), axis=1)  # [C, N]
 
+            # equal-score groups along the ordered axis (for the rotation)
+            score_ord = jnp.take_along_axis(masked, order, axis=-1)
+            pos = jnp.broadcast_to(
+                jnp.arange(n_total, dtype=jnp.int32)[None, :],
+                (chunk, n_total))
+            is_start = jnp.concatenate(
+                [jnp.ones((chunk, 1), bool),
+                 score_ord[:, 1:] != score_ord[:, :-1]], axis=1)
+            g_start = lax.cummax(jnp.where(is_start, pos, 0), axis=1)
+            starts = jnp.where(is_start, pos, jnp.int32(n_total))
+            # next group start AFTER j: suffix-min of starts, shifted left
+            sfx = jnp.flip(lax.cummin(jnp.flip(starts, axis=1), axis=1), axis=1)
+            g_end = jnp.concatenate(
+                [sfx[:, 1:], jnp.full((chunk, 1), n_total, jnp.int32)], axis=1)
+            g_size = g_end - g_start
+            ccap_before = jnp.where(
+                g_start > 0,
+                jnp.take_along_axis(ccap, jnp.maximum(g_start - 1, 0), axis=1),
+                0)
+            n_feas = jnp.sum(mask, axis=-1).astype(jnp.int32)
+            return (order.astype(jnp.int32), ccap, g_start, g_size,
+                    ccap_before, n_feas)
+
+        zero_i = lambda: jnp.zeros((chunk, n_total), jnp.int32)  # noqa: E731
         return lax.cond(
             live.any(), sweep,
-            lambda _: (jnp.zeros((chunk, n_total), jnp.int32),
+            lambda _: (zero_i(), zero_i(), zero_i(),
+                       jnp.ones((chunk, n_total), jnp.int32), zero_i(),
                        jnp.zeros((chunk,), jnp.int32)), None)
 
-    tied_list, n_tied = lax.map(one_chunk, jnp.arange(n_chunks))
-    tied_list = tied_list.reshape(k_total, n_total)
-    n_tied = n_tied.reshape(k_total)
+    order, ccap, g_start, g_size, ccap_before, n_feas = lax.map(
+        one_chunk, jnp.arange(n_chunks))
+    order = order.reshape(k_total, n_total)
+    ccap = ccap.reshape(k_total, n_total)
+    g_start = g_start.reshape(k_total, n_total)
+    g_size = g_size.reshape(k_total, n_total)
+    ccap_before = ccap_before.reshape(k_total, n_total)
+    n_feas = n_feas.reshape(k_total)
 
     t_total = task_cls.shape[0]
-    nt = n_tied[task_cls]                                     # [T]
-    kth = (jnp.arange(t_total, dtype=jnp.int32)
-           % jnp.maximum(nt, 1)).astype(jnp.int32)
-    choice = tied_list[task_cls, kth]
-    return jnp.where((nt > 0) & active, choice, -1)
+    # rank of each ACTIVE task within its class, in flat order: sort by
+    # (class, inactive-last, flat index), take the position inside the
+    # (class, active) segment — O(T log T), no T x K blowup
+    idxs = jnp.arange(t_total, dtype=jnp.int32)
+    ordix = jnp.lexsort((idxs, ~active, task_cls))
+    sorted_cls = task_cls[ordix]
+    sorted_act = active[ordix]
+    seg_start = jnp.concatenate(
+        [jnp.ones(1, bool),
+         (sorted_cls[1:] != sorted_cls[:-1]) | (sorted_act[1:] != sorted_act[:-1])])
+    start_idx = lax.cummax(jnp.where(seg_start, idxs, 0))
+    rank = jnp.zeros(t_total, jnp.int32).at[ordix].set(idxs - start_idx)
+
+    # slot = first ordered position whose cumulative capacity exceeds the
+    # task's rank — a vectorized binary search over each task's class row:
+    # O(T log N) gathers instead of materializing a [T, N] comparison
+    lo = jnp.zeros(t_total, jnp.int32)
+    hi = jnp.full(t_total, n_total, jnp.int32)
+    for _ in range(max(1, (n_total - 1).bit_length())):
+        mid = (lo + hi) // 2
+        go_right = ccap[task_cls, jnp.minimum(mid, n_total - 1)] <= rank
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right, hi, mid)
+    slot = lo
+    # tasks whose rank exceeds total estimated capacity retry next round on
+    # the refreshed state; clamp keeps the gathers in bounds
+    overflow = slot >= n_feas[task_cls]
+    slot = jnp.clip(slot, 0, n_total - 1)
+    tk = task_cls
+    if spec.use_binpack:
+        # packing policy: serial binpack breaks round-start ties TOWARD the
+        # node it just filled (fill one node, then the next); the
+        # sequential capacity walk reproduces that — no rotation
+        final = slot
+    else:
+        # spreading policies (least-requested/balanced): serial behavior on
+        # tied nodes is round-robin; rotate within the equal-score group
+        gs = g_start[tk, slot]
+        gz = jnp.maximum(g_size[tk, slot], 1)
+        local = rank - ccap_before[tk, slot]
+        final = gs + (jnp.maximum(local, 0) % gz)
+    choice = order[tk, final]
+    feasible = (n_feas[tk] > 0) & ~overflow & active
+    return jnp.where(feasible, choice, -1)
 
 
 def _seg_limbs(req_s, start_idx):

@@ -174,6 +174,12 @@ class DensePreemptView:
         # pod-count feasibility cached; invalidated only by on_(un)pipeline
         self._cnt_ok = self.cnt < self.max_tasks
         self._poisoned = False
+        # per-class cached [N] score rows: scores depend only on (class,
+        # node used-state) and used changes ONE node per pipeline, so each
+        # row replays the touched-node log instead of recomputing N scores
+        # per preemptor. _touched grows by ~1 per pipeline; rows sync lazily.
+        self._score_rows: Dict[tuple, list] = {}  # key -> [row, sync_pos]
+        self._touched: List[int] = []
 
     def poison(self) -> None:
         """A pod with (anti-)affinity was PLACED by the serial fallback
@@ -241,6 +247,29 @@ class DensePreemptView:
         return mask, self._sig_aff[key]
 
     # -- scoring (numpy mirror of kernels.fused_scores) --------------------
+
+    def _score_row(self, task, aff: Optional[np.ndarray]) -> np.ndarray:
+        """Cached full [N] score row for the task's class, lazily replaying
+        score recomputes for nodes touched by pipelines since last sync."""
+        res = task.resreq
+        key = (
+            enc_mod._pod_encode_traits(task.pod)[0] if task.pod is not None
+            else "<none>",
+            res.milli_cpu, res.memory,
+            tuple(sorted((res.scalar_resources or {}).items())),
+        )
+        cached = self._score_rows.get(key)
+        touched = self._touched
+        if cached is None:
+            row = self._scores(task, np.arange(self.n), aff)
+            self._score_rows[key] = [row, len(touched)]
+            return row
+        row, sync = cached
+        if sync < len(touched):
+            stale = np.unique(np.array(touched[sync:], np.int64))
+            row[stale] = self._scores(task, stale, aff)
+            cached[1] = len(touched)
+        return row
 
     def _scores(self, task, sel: np.ndarray, aff: Optional[np.ndarray]) -> np.ndarray:
         req = np.zeros(len(self.rnames), np.float64)
@@ -326,7 +355,7 @@ class DensePreemptView:
 
         if len(sel) == 0:
             return []
-        scores = self._scores(task, sel, aff)
+        scores = self._score_row(task, aff)[sel]
         order = np.argsort(-scores, kind="stable")
         return [self.nodes[i] for i in sel[order]]
 
@@ -357,6 +386,7 @@ class DensePreemptView:
             self.used[i, si] += (task.resreq.scalar_resources or {}).get(rn, 0.0)
         self.cnt[i] += 1
         self._cnt_ok[i] = self.cnt[i] < self.max_tasks[i]
+        self._touched.append(i)
 
     def on_unpipeline(self, node_name: str, task) -> None:
         i = self._node_idx.get(node_name)
@@ -368,3 +398,4 @@ class DensePreemptView:
             self.used[i, si] -= (task.resreq.scalar_resources or {}).get(rn, 0.0)
         self.cnt[i] -= 1
         self._cnt_ok[i] = self.cnt[i] < self.max_tasks[i]
+        self._touched.append(i)

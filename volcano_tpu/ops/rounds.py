@@ -241,7 +241,10 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
     # O(T log N) gathers instead of materializing a [T, N] comparison
     lo = jnp.zeros(t_total, jnp.int32)
     hi = jnp.full(t_total, n_total, jnp.int32)
-    for _ in range(max(1, (n_total - 1).bit_length())):
+    # interval [0, n_total] holds n_total+1 answers => ceil(log2(n+1)) =
+    # n_total.bit_length() halvings (one fewer under-shoots slots when the
+    # node count is a power of two)
+    for _ in range(max(1, n_total.bit_length())):
         mid = (lo + hi) // 2
         go_right = ccap[task_cls, jnp.minimum(mid, n_total - 1)] <= rank
         lo = jnp.where(go_right, mid + 1, lo)

@@ -1,0 +1,156 @@
+"""Columnar pod table (scheduler/cache/podtable.py): row lifecycle,
+generation-validated gathers, encoder fallback on staleness, and the
+solver's content-validated device-buffer cache (_stage)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from volcano_tpu.api import objects
+from volcano_tpu.bench.clusters import make_cache, make_tiers
+import volcano_tpu.scheduler.actions  # noqa: F401
+from volcano_tpu.ops import encoder, solver
+from volcano_tpu.scheduler.framework import close_session, get_action, open_session
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list_with_pods,
+)
+
+
+def _cluster(tasks=8):
+    c = make_cache()
+    c.add_queue(build_queue("default"))
+    for n in range(3):
+        c.add_node(build_node(
+            f"n{n}", build_resource_list_with_pods("8", "16Gi")))
+    for g in range(tasks // 4):
+        pg = f"pg{g}"
+        c.add_pod_group(build_pod_group(pg, namespace="d", min_member=1))
+        for i in range(4):
+            c.add_pod(build_pod(
+                "d", f"{pg}-{i}", "", objects.POD_PHASE_PENDING,
+                {"cpu": "500m", "memory": "1Gi"}, pg, priority=i))
+    return c
+
+
+class TestPodTable:
+    def test_rows_assigned_and_released(self):
+        c = _cluster(8)
+        t = c.pod_table
+        assert len(t._uid_row) == 8
+        # every cached task carries a valid (row, gen)
+        for job in c.jobs.values():
+            for task in job.tasks.values():
+                assert task.row >= 0
+                assert t.gen[task.row] == task.row_gen
+        pod = c.jobs[next(iter(c.jobs))].tasks[
+            next(iter(c.jobs[next(iter(c.jobs))].tasks))].pod
+        c.delete_pod(pod)
+        assert len(t._uid_row) == 7
+
+    def test_update_bumps_generation(self):
+        c = _cluster(4)
+        job = next(iter(c.jobs.values()))
+        task = next(iter(job.tasks.values()))
+        old_row, old_gen = task.row, task.row_gen
+        group = task.pod.metadata.annotations[objects.GROUP_NAME_ANNOTATION_KEY]
+        new_pod = build_pod("d", task.name, "", objects.POD_PHASE_PENDING,
+                            {"cpu": "2", "memory": "2Gi"}, group)
+        new_pod.metadata.uid = task.uid
+        c.update_pod_from_watch(task.pod, new_pod)
+        new_task = c.jobs[task.job].tasks[task.uid]
+        assert (new_task.row, new_task.row_gen) != (old_row, old_gen)
+        # a stale (row, gen) gather must fail validation
+        g = c.pod_table.gather(np.array([old_row]), np.array([old_gen]), [])
+        assert g is None
+
+    def test_gather_values_match_objects(self):
+        c = _cluster(8)
+        t = c.pod_table
+        tasks = [task for job in c.jobs.values() for task in job.tasks.values()]
+        rows = np.array([x.row for x in tasks])
+        gens = np.array([x.row_gen for x in tasks])
+        g = t.gather(rows, gens, [])
+        assert g is not None
+        for i, task in enumerate(tasks):
+            assert g["cpu"][i] == task.resreq.milli_cpu
+            assert g["mem"][i] == task.resreq.memory
+            assert g["priority"][i] == task.priority
+
+    def test_encoder_falls_back_on_stale_rows(self):
+        """Stale rows between snapshot and encode => object-walk fallback,
+        identical output."""
+        c = _cluster(8)
+        tiers = make_tiers(["tpuscore"], ["priority", "gang"],
+                           ["drf", "predicates", "proportion", "nodeorder"])
+        ssn = open_session(c, tiers)
+        try:
+            enc_fast = encoder.encode_session(ssn, allow_residue=True)
+            # poison every session task's generation
+            for job in ssn.jobs.values():
+                for task in job.tasks.values():
+                    task.row_gen = -99
+            enc_slow = encoder.encode_session(ssn, allow_residue=True)
+            assert [t.uid for t in enc_fast.task_infos] == \
+                   [t.uid for t in enc_slow.task_infos]
+            np.testing.assert_array_equal(
+                enc_fast.arrays["task_req"], enc_slow.arrays["task_req"])
+            np.testing.assert_array_equal(
+                enc_fast.arrays["job_task_count"],
+                enc_slow.arrays["job_task_count"])
+        finally:
+            close_session(ssn)
+
+    def test_grow_past_initial_capacity(self):
+        from volcano_tpu.scheduler.cache.podtable import PodTable
+
+        t = PodTable()
+        cap0 = t._cap
+
+        class FakeTask:
+            def __init__(self, i):
+                self.uid = f"u{i}"
+                from volcano_tpu.api.resource import Resource
+
+                self.resreq = Resource(100.0, 1024.0)
+                self.init_resreq = Resource(100.0, 1024.0)
+                self.priority = 1
+                self.row = -1
+                self.row_gen = -1
+
+        pods = []
+        for i in range(cap0 + 10):
+            pod = build_pod("d", f"p{i}", "", objects.POD_PHASE_PENDING,
+                            {"cpu": "100m"})
+            task = FakeTask(i)
+            t.add(pod, task)
+            pods.append((pod, task))
+        assert t._cap > cap0
+        assert len(t._uid_row) == cap0 + 10
+        rows = np.array([task.row for _, task in pods])
+        gens = np.array([task.row_gen for _, task in pods])
+        assert t.gather(rows, gens, []) is not None
+
+
+class TestDeviceBufferCache:
+    def test_stage_reuses_unchanged_buffers(self):
+        solver._DEVICE_CACHE.clear()
+        a = {"x.f": np.arange(8, dtype=np.float32)}
+        s1 = solver._stage(a)
+        s2 = solver._stage({"x.f": np.arange(8, dtype=np.float32)})
+        assert s1["x.f"] is s2["x.f"], "identical bytes must reuse the device twin"
+        s3 = solver._stage({"x.f": np.arange(1, 9, dtype=np.float32)})
+        assert s3["x.f"] is not s1["x.f"], "changed bytes must re-transfer"
+        solver._DEVICE_CACHE.clear()
+
+    def test_stage_detects_shape_and_dtype_change(self):
+        solver._DEVICE_CACHE.clear()
+        s1 = solver._stage({"y.i": np.arange(4, dtype=np.int32)})
+        s2 = solver._stage({"y.i": np.arange(5, dtype=np.int32)})
+        assert s2["y.i"].shape != s1["y.i"].shape
+        s3 = solver._stage({"y.i": np.arange(5, dtype=np.int64)})
+        assert np.asarray(s3["y.i"]).dtype == np.int64
+        solver._DEVICE_CACHE.clear()

@@ -177,9 +177,15 @@ class DensePreemptView:
         # per-class cached [N] score rows: scores depend only on (class,
         # node used-state) and used changes ONE node per pipeline, so each
         # row replays the touched-node log instead of recomputing N scores
-        # per preemptor. _touched grows by ~1 per pipeline; rows sync lazily.
+        # per preemptor. _touched grows by ~1 per pipeline; rows sync
+        # lazily. A key is only PROMOTED to a full cached row on its second
+        # sighting (heterogeneous one-off requests would otherwise pay
+        # full-N scoring for zero hits), and the cache is bounded.
         self._score_rows: Dict[tuple, list] = {}  # key -> [row, sync_pos]
+        self._seen_keys: set = set()
         self._touched: List[int] = []
+
+    _SCORE_ROW_CAP = 256  # distinct promoted classes per action
 
     def poison(self) -> None:
         """A pod with (anti-)affinity was PLACED by the serial fallback
@@ -248,9 +254,12 @@ class DensePreemptView:
 
     # -- scoring (numpy mirror of kernels.fused_scores) --------------------
 
-    def _score_row(self, task, aff: Optional[np.ndarray]) -> np.ndarray:
-        """Cached full [N] score row for the task's class, lazily replaying
-        score recomputes for nodes touched by pipelines since last sync."""
+    def _score_row(self, task, aff: Optional[np.ndarray],
+                   sel: np.ndarray) -> np.ndarray:
+        """Scores for the selected nodes, via the class's cached [N] row
+        when the class repeats (lazily replaying recomputes for nodes
+        touched by pipelines since last sync); one-off classes compute only
+        the window."""
         res = task.resreq
         key = (
             enc_mod._pod_encode_traits(task.pod)[0] if task.pod is not None
@@ -261,15 +270,20 @@ class DensePreemptView:
         cached = self._score_rows.get(key)
         touched = self._touched
         if cached is None:
+            if (key not in self._seen_keys
+                    or len(self._score_rows) >= self._SCORE_ROW_CAP):
+                # first sighting (or cache full): windowed compute only
+                self._seen_keys.add(key)
+                return self._scores(task, sel, aff)
             row = self._scores(task, np.arange(self.n), aff)
             self._score_rows[key] = [row, len(touched)]
-            return row
+            return row[sel]
         row, sync = cached
         if sync < len(touched):
             stale = np.unique(np.array(touched[sync:], np.int64))
             row[stale] = self._scores(task, stale, aff)
             cached[1] = len(touched)
-        return row
+        return row[sel]
 
     def _scores(self, task, sel: np.ndarray, aff: Optional[np.ndarray]) -> np.ndarray:
         req = np.zeros(len(self.rnames), np.float64)
@@ -355,7 +369,7 @@ class DensePreemptView:
 
         if len(sel) == 0:
             return []
-        scores = self._score_row(task, aff)[sel]
+        scores = self._score_row(task, aff, sel)
         order = np.argsort(-scores, kind="stable")
         return [self.nodes[i] for i in sel[order]]
 

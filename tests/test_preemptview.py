@@ -84,6 +84,33 @@ class TestPreemptReclaimParity:
         finally:
             close_session(ssn)
 
+    def test_scalar_score_twin_bit_identical(self):
+        """_score_one (scalar replay path) must match _scores (vectorized)
+        bit-for-bit on every node, including after pipelines mutate state."""
+        import numpy as np
+
+        cache, _, tpu_tiers, _, _ = build_config(4, 0.02)
+        ssn = open_session(cache, tpu_tiers)
+        try:
+            view = preemptview.build(ssn)
+            tasks = [
+                t for job in ssn.jobs.values()
+                for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
+                if not t.resreq.is_empty()
+            ][:5]
+            for k, task in enumerate(tasks):
+                if k:  # mutate state between checks
+                    view.on_pipeline(view.node_names[k], task)
+                rows = view._rows(task)
+                assert rows is not None
+                aff = rows[1]
+                allnodes = np.arange(view.n)
+                vec = view._scores(task, allnodes, aff)
+                for i in range(view.n):
+                    assert view._score_one(task, i, aff) == vec[i], (k, i)
+        finally:
+            close_session(ssn)
+
     def test_poison_retires_view_after_fallback_placement(self):
         """A serially-placed un-modeled pod (affinity/ports) makes cached
         masks stale; poison() must force serial for the rest of the action."""

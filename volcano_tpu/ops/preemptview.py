@@ -280,10 +280,65 @@ class DensePreemptView:
             return row[sel]
         row, sync = cached
         if sync < len(touched):
-            stale = np.unique(np.array(touched[sync:], np.int64))
-            row[stale] = self._scores(task, stale, aff)
+            stale = sorted(set(touched[sync:]))
+            if len(stale) <= 4:
+                # scalar replay: numpy's fixed per-op overhead dwarfs the
+                # work for 1-2 nodes (the common one-pipeline-per-call case)
+                for i in stale:
+                    row[i] = self._score_one(task, i, aff)
+            else:
+                stale_arr = np.asarray(stale, np.int64)
+                row[stale_arr] = self._scores(task, stale_arr, aff)
             cached[1] = len(touched)
         return row[sel]
+
+    def _score_one(self, task, i: int, aff: Optional[np.ndarray]) -> float:
+        """Scalar twin of _scores for one node — Python floats are IEEE
+        f64, so with the same operation order the result is bit-identical
+        to the vectorized path (asserted by tests/test_preemptview.py)."""
+        import math
+
+        res = task.resreq
+        cpu = res.milli_cpu
+        mem = res.memory
+        nz_cpu = cpu if cpu else nodeorder_mod.DEFAULT_MILLI_CPU_REQUEST
+        nz_mem = mem if mem else nodeorder_mod.DEFAULT_MEMORY_REQUEST
+        alloc = self.alloc[i]
+        used = self.used[i]
+        score = 0.0
+        if self.use_nodeorder:
+            cap_cpu = float(alloc[0]); cap_mem = float(alloc[1])
+            want_cpu = float(used[0]) + nz_cpu
+            want_mem = float(used[1]) + nz_mem
+            d_cpu = ((cap_cpu - want_cpu) * MAX_PRIORITY / (cap_cpu if cap_cpu > 0 else 1.0)
+                     if (cap_cpu > 0 and want_cpu <= cap_cpu) else 0.0)
+            d_mem = ((cap_mem - want_mem) * MAX_PRIORITY / (cap_mem if cap_mem > 0 else 1.0)
+                     if (cap_mem > 0 and want_mem <= cap_mem) else 0.0)
+            least = math.floor((d_cpu + d_mem) / 2.0)
+            cpu_frac = want_cpu / (cap_cpu if cap_cpu > 0 else 1.0)
+            mem_frac = want_mem / (cap_mem if cap_mem > 0 else 1.0)
+            balanced = (math.floor(MAX_PRIORITY - abs(cpu_frac - mem_frac) * MAX_PRIORITY)
+                        if (cap_cpu > 0 and cap_mem > 0
+                            and cpu_frac < 1.0 and mem_frac < 1.0) else 0.0)
+            score += least * self.least_req_w + balanced * self.balanced_w
+            if aff is not None:
+                score += float(aff[i]) * self.node_aff_w
+        if self.use_binpack:
+            req = [cpu, mem]
+            for rn in self.rnames[2:]:
+                req.append((res.scalar_resources or {}).get(rn, 0.0))
+            w_sum = 0.0
+            raw = 0.0
+            for ri, r in enumerate(req):
+                w = self.binpack_w[ri] if r > 0 else 0.0
+                w_sum += w
+                a = float(alloc[ri])
+                want = r + float(used[ri])
+                if a > 0 and want <= a:
+                    raw += want * w / a
+            if w_sum > 0:
+                score += raw / w_sum * MAX_PRIORITY * self.binpack_weight
+        return score
 
     def _scores(self, task, sel: np.ndarray, aff: Optional[np.ndarray]) -> np.ndarray:
         req = np.zeros(len(self.rnames), np.float64)

@@ -296,8 +296,6 @@ class DensePreemptView:
         """Scalar twin of _scores for one node — Python floats are IEEE
         f64, so with the same operation order the result is bit-identical
         to the vectorized path (asserted by tests/test_preemptview.py)."""
-        import math
-
         res = task.resreq
         cpu = res.milli_cpu
         mem = res.memory

@@ -269,7 +269,13 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
         final = gs + (jnp.maximum(local, 0) % gz)
     choice = order[tk, final]
     feasible = (n_feas[tk] > 0) & ~overflow & active
-    return jnp.where(feasible, choice, -1)
+    # conservative retry choice: each task's class-best feasible node (the
+    # pre-capacity-walk argmax semantics). Used by the stalemate-breaker
+    # round: the deterministic capacity walk can map a task to the same
+    # _resolve-rejected node every round; the best-node choice guarantees
+    # progress whenever anything feasible fits alone.
+    cons_choice = jnp.where((n_feas[tk] > 0) & active, order[tk, 0], -1)
+    return jnp.where(feasible, choice, -1), cons_choice
 
 
 def _seg_limbs(req_s, start_idx):
@@ -492,8 +498,12 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         ns_alloc=enc["ns_alloc0"],
         rounds=jnp.int32(0),
         progress=jnp.bool_(True),
+        tried_cons=jnp.bool_(False),  # conservative retry owed after stall
         dead=jnp.bool_(False),  # outer fixpoint reached
     )
+    # stall pairs cost two rounds per placement or rollback in the worst
+    # case, so the runaway bound is 2(T+J)+8 (see outer_body)
+    round_budget = 2 * (t_total + j_total) + 8
 
     def round_body(st):
         job_rank = _job_rank(spec, enc, st["job_placed"], st["job_alloc"])
@@ -505,7 +515,18 @@ def solve_rounds(spec: SolveSpec, enc: dict):
                                  enc["eps"], enc["is_scalar"])
             active = active & ~over[task_queue]
 
-        choice = _choices(spec, enc, st["idle"], st["used"], st["cnt"], active)
+        # stalemate breaker, folded into the ONE traced body: when the
+        # previous round made no progress, this round uses the class-best
+        # choice — the capacity walk is deterministic, so a task whose
+        # assigned node keeps failing _resolve would repeat forever even
+        # though other feasible nodes have room; the best-node choice
+        # guarantees progress whenever anything feasible fits alone. A
+        # conservative round that ALSO lands nothing sets tried_cons and
+        # the loop exits to the rollback fixpoint.
+        cons = ~st["progress"]
+        choice, cons_choice = _choices(
+            spec, enc, st["idle"], st["used"], st["cnt"], active)
+        choice = jnp.where(cons, cons_choice, choice)
         accept = _resolve(spec, enc, st["idle"], st["cnt"], choice, task_rank)
         if spec.use_prop_overused:
             accept = _queue_budget(enc, st["queue_alloc"], accept,
@@ -517,6 +538,7 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         used = st["used"].at[node].add(dreq)
         cnt = st["cnt"].at[node].add(accept.astype(jnp.int32))
         assign = jnp.where(accept, choice, st["assign"])
+        any_accept = jnp.any(accept)
         return dict(
             st,
             idle=idle, used=used, cnt=cnt, assign=assign,
@@ -526,7 +548,8 @@ def solve_rounds(spec: SolveSpec, enc: dict):
             queue_alloc=st["queue_alloc"].at[task_queue].add(dreq),
             ns_alloc=st["ns_alloc"].at[task_ns].add(dreq),
             rounds=st["rounds"] + 1,
-            progress=jnp.any(accept),
+            progress=any_accept,
+            tried_cons=cons & ~any_accept,
         )
 
     def rollback(st):
@@ -559,21 +582,29 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         ), jnp.any(cand)
 
     def outer_cond(st):
-        return ~st["dead"] & (st["rounds"] < t_total + j_total + 8)
+        return ~st["dead"] & (st["rounds"] < round_budget)
 
     def outer_body(st):
-        # `any(active)` skips the final no-op confirmation sweep when every
-        # task is already placed — the common full-placement session would
-        # otherwise pay one entire extra (T x N) round to learn "no progress"
+        # inner loop runs while progressing OR a conservative retry is
+        # still owed (tried_cons False after a stall); `any(active)` skips
+        # the final no-op confirmation sweep when every task is placed.
+        # Budget 2(T+J): each stall pair (normal + conservative) either
+        # places >= 1 task or exits to a rollback that retires one job.
         st = lax.while_loop(
-            lambda s: s["progress"] & jnp.any(s["active"])
-            & (s["rounds"] < t_total + j_total + 8),
+            lambda s: (s["progress"] | ~s["tried_cons"])
+            & jnp.any(s["active"]) & (s["rounds"] < round_budget),
             round_body, st)
         st, _rolled = rollback(st)
-        return st
+        return dict(st, tried_cons=jnp.bool_(False))
 
     st = lax.while_loop(outer_cond, outer_body, st)
-    return st["assign"], st["rounds"]
+    # structural gang-atomicity net: on a normal exit (dead=True) no gang
+    # with placements is short, so this is a no-op; on a budget exhaustion
+    # it strips partially-placed gangs instead of letting the bulk apply
+    # bind them (the apply path does not re-check job readiness)
+    short = (enc["job_ready_base"] + st["job_placed"]) < enc["job_ready_threshold"]
+    assign = jnp.where(short[task_job], -1, st["assign"])
+    return assign, st["rounds"]
 
 
 def _le_eps_rows(l, r, eps, is_scalar):

@@ -9,7 +9,7 @@ job-lifecycle controller manager, admission, and a CLI.
 The control plane keeps the session/plugin architecture; the per-session
 placement solve — predicate masks x node scores x gang feasibility x
 fair-share over (tasks x nodes) — is a batched JAX/XLA constraint solve
-sharded across TPU chips (see volcano_tpu.ops and volcano_tpu.parallel),
+sharded across TPU chips (see volcano_tpu.ops),
 behind the plugin API so the serial loop remains as fallback and parity
 oracle.
 """

@@ -25,11 +25,19 @@ _TRIED = False
 _BUILD_THREAD = None
 
 
-def _build(src: str, modname: str) -> bool:
+def _paths(src: str, modname: str):
     ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    out = os.path.join(_DIR, modname + ext)
-    src_path = os.path.join(_DIR, src)
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src_path):
+    return os.path.join(_DIR, src), os.path.join(_DIR, modname + ext)
+
+
+def _is_fresh(src_path: str, out: str) -> bool:
+    return (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src_path))
+
+
+def _build(src: str, modname: str) -> bool:
+    src_path, out = _paths(src, modname)
+    if _is_fresh(src_path, out):
         return True
     cc = sysconfig.get_config_var("CC") or "cc"
     include = sysconfig.get_paths()["include"]
@@ -79,10 +87,8 @@ def get_fastapply_nowait():
         return _FASTAPPLY
     if os.environ.get("VOLCANO_TPU_NO_NATIVE"):
         return None
-    ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    out = os.path.join(_DIR, "_fastapply" + ext)
-    src = os.path.join(_DIR, "fastapply.c")
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+    src_path, out = _paths("fastapply.c", "_fastapply")
+    if _is_fresh(src_path, out):
         return get_fastapply()  # import only — no compiler run
     if _BUILD_THREAD is None:
         import threading

@@ -384,17 +384,25 @@ class DensePreemptView:
 
     # -- candidate streams -------------------------------------------------
 
-    def candidates(self, task) -> Optional[List]:
-        """Feasible nodes for `task` in EXACT serial order: the round-robin
-        sampling window of predicate_nodes, then sort_nodes's stable
-        descending-score order. None => caller must run the serial sweep."""
+    def _eligible(self, task):
+        """(eligible mask, aff row) for `task`, or None for serial fallback
+        — the signature mask gated by the pod-count feasibility cache."""
         rows = self._rows(task)
         if rows is None:
             return None
         mask, aff = rows
-        eligible = mask
         if self.check_pod_count and task.pod is not None:
-            eligible = eligible & self._cnt_ok
+            mask = mask & self._cnt_ok
+        return mask, aff
+
+    def candidates(self, task) -> Optional[List]:
+        """Feasible nodes for `task` in EXACT serial order: the round-robin
+        sampling window of predicate_nodes, then sort_nodes's stable
+        descending-score order. None => caller must run the serial sweep."""
+        rows = self._eligible(task)
+        if rows is None:
+            return None
+        eligible, aff = rows
 
         n = self.n
         if n == 0:
@@ -432,37 +440,28 @@ class DensePreemptView:
         window). Returns a LAZY iterator — backfill normally consumes one
         element, and materializing ~N NodeInfos per task would cost more
         than the predicate sweep it replaces. None => serial fallback."""
-        rows = self._rows(task)
+        rows = self._eligible(task)
         if rows is None:
             return None
-        eligible = rows[0]
-        if self.check_pod_count and task.pod is not None:
-            eligible = eligible & self._cnt_ok
         nodes = self.nodes
-        return (nodes[i] for i in np.nonzero(eligible)[0])
+        return (nodes[i] for i in np.nonzero(rows[0])[0])
 
     # -- state updates (pipeline is the only op that moves `used`/cnt) -----
 
-    def on_pipeline(self, node_name: str, task) -> None:
+    def _node_delta(self, node_name: str, task, sign: float) -> None:
         i = self._node_idx.get(node_name)
         if i is None:
             return
-        self.used[i, 0] += task.resreq.milli_cpu
-        self.used[i, 1] += task.resreq.memory
+        self.used[i, 0] += sign * task.resreq.milli_cpu
+        self.used[i, 1] += sign * task.resreq.memory
         for si, rn in enumerate(self.rnames[2:], start=2):
-            self.used[i, si] += (task.resreq.scalar_resources or {}).get(rn, 0.0)
-        self.cnt[i] += 1
+            self.used[i, si] += sign * (task.resreq.scalar_resources or {}).get(rn, 0.0)
+        self.cnt[i] += int(sign)
         self._cnt_ok[i] = self.cnt[i] < self.max_tasks[i]
         self._touched.append(i)
 
+    def on_pipeline(self, node_name: str, task) -> None:
+        self._node_delta(node_name, task, 1.0)
+
     def on_unpipeline(self, node_name: str, task) -> None:
-        i = self._node_idx.get(node_name)
-        if i is None:
-            return
-        self.used[i, 0] -= task.resreq.milli_cpu
-        self.used[i, 1] -= task.resreq.memory
-        for si, rn in enumerate(self.rnames[2:], start=2):
-            self.used[i, si] -= (task.resreq.scalar_resources or {}).get(rn, 0.0)
-        self.cnt[i] -= 1
-        self._cnt_ok[i] = self.cnt[i] < self.max_tasks[i]
-        self._touched.append(i)
+        self._node_delta(node_name, task, -1.0)

@@ -448,7 +448,7 @@ class DensePreemptView:
 
     # -- state updates (pipeline is the only op that moves `used`/cnt) -----
 
-    def _node_delta(self, node_name: str, task, sign: float) -> None:
+    def _node_delta(self, node_name: str, task, sign: int) -> None:
         i = self._node_idx.get(node_name)
         if i is None:
             return
@@ -456,12 +456,12 @@ class DensePreemptView:
         self.used[i, 1] += sign * task.resreq.memory
         for si, rn in enumerate(self.rnames[2:], start=2):
             self.used[i, si] += sign * (task.resreq.scalar_resources or {}).get(rn, 0.0)
-        self.cnt[i] += int(sign)
+        self.cnt[i] += sign
         self._cnt_ok[i] = self.cnt[i] < self.max_tasks[i]
         self._touched.append(i)
 
     def on_pipeline(self, node_name: str, task) -> None:
-        self._node_delta(node_name, task, 1.0)
+        self._node_delta(node_name, task, 1)
 
     def on_unpipeline(self, node_name: str, task) -> None:
-        self._node_delta(node_name, task, -1.0)
+        self._node_delta(node_name, task, -1)

@@ -1,0 +1,63 @@
+"""LowLatencyGC (utils/gcpolicy.py): refcounted install/uninstall and
+between-cycle maintenance."""
+
+from __future__ import annotations
+
+import gc
+
+from volcano_tpu.utils.gcpolicy import LowLatencyGC
+
+
+class TestLowLatencyGC:
+    def test_install_disables_and_uninstall_restores(self):
+        was = gc.isenabled()
+        gc.enable()
+        try:
+            p = LowLatencyGC.install()
+            assert not gc.isenabled()
+            p.maintain()  # young-gen collect must not re-enable
+            assert not gc.isenabled()
+            p.uninstall()
+            assert gc.isenabled()
+        finally:
+            (gc.enable if was else gc.disable)()
+
+    def test_refcounted_overlapping_installs(self):
+        """Two HA loops: the first uninstall must NOT re-enable automatic
+        GC under the survivor; the last one restores the outer state."""
+        was = gc.isenabled()
+        gc.enable()
+        try:
+            a = LowLatencyGC.install()
+            b = LowLatencyGC.install()
+            a.uninstall()
+            assert not gc.isenabled(), "survivor still runs under the policy"
+            b.uninstall()
+            assert gc.isenabled()
+        finally:
+            (gc.enable if was else gc.disable)()
+
+    def test_double_uninstall_is_idempotent(self):
+        was = gc.isenabled()
+        gc.enable()
+        try:
+            a = LowLatencyGC.install()
+            b = LowLatencyGC.install()
+            a.uninstall()
+            a.uninstall()  # second call must not decrement again
+            assert not gc.isenabled()
+            b.uninstall()
+            assert gc.isenabled()
+        finally:
+            (gc.enable if was else gc.disable)()
+
+    def test_full_collection_on_stride(self):
+        was = gc.isenabled()
+        try:
+            p = LowLatencyGC.install()
+            before = gc.get_count()  # noqa: F841 (smoke the API)
+            for _ in range(LowLatencyGC.FULL_EVERY):
+                p.maintain()  # the stride-th call runs a full collect
+            p.uninstall()
+        finally:
+            (gc.enable if was else gc.disable)()

@@ -269,3 +269,36 @@ class TestExampleCorpus:
             with open(os.path.join(invalid_dir, name)) as f:
                 with pytest.raises(AdmissionError):
                     job_cli.run_job(cluster.store, f.read())
+
+
+class TestVersionBanner:
+    def test_version_string_fields(self):
+        from volcano_tpu import version
+
+        banner = version.version_string()
+        assert "Version:" in banner
+        assert "Git SHA:" in banner
+        assert "Built At:" in banner
+        assert version.VERSION in banner
+
+
+class TestObservabilityConcurrency:
+    def test_concurrent_scrapes(self):
+        """ThreadingHTTPServer must serve overlapping /metrics scrapes."""
+        import concurrent.futures
+        import urllib.request
+
+        metrics.reset()
+        metrics.update_e2e_duration(0.01)
+        srv = ObservabilityServer(":0").start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+
+            def scrape(_):
+                return urllib.request.urlopen(url, timeout=5).status
+
+            with concurrent.futures.ThreadPoolExecutor(8) as ex:
+                statuses = list(ex.map(scrape, range(16)))
+            assert statuses == [200] * 16
+        finally:
+            srv.stop()

@@ -41,6 +41,16 @@ def _session_once(cache, tiers, actions, mesh=None):
         tpuscore.set_default_mesh(mesh)
     if _GC_POLICY is not None:
         _GC_POLICY.maintain()  # between-cycle collection, as in the loop
+    # compile watching needs jax; the serial baseline must keep running on
+    # jax-free hosts (it never touches the device path)
+    try:
+        from volcano_tpu.utils.jaxcompile import CompileWatcher
+
+        win = CompileWatcher.install().window()
+    except Exception:
+        # no jax, or a jax whose (private) monitoring hook moved — compile
+        # accounting degrades to absent, the measurement itself still runs
+        win = None
     t0 = time.perf_counter()
     ssn = open_session(cache, tiers)
     t_open = time.perf_counter()
@@ -49,6 +59,12 @@ def _session_once(cache, tiers, actions, mesh=None):
     t_act = time.perf_counter()
     profile = dict(ssn.plugins["tpuscore"].profile) if "tpuscore" in ssn.plugins else {}
     close_session(ssn)
+    # compile accounting: a warm session with compiles > 0 is a retrace —
+    # exactly the regression the warm-sample spread is meant to expose
+    if win is not None:
+        cs = win.delta()
+        profile["compiles"] = cs.compiles
+        profile["compile_s"] = round(cs.compile_s, 3)
     return {
         "open_s": t_open - t0,
         "actions_s": t_act - t_open,
@@ -110,25 +126,37 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
         # program every cycle).
         samples = []
         warm = None
+        warm_compiles = []
         for _ in range(warm_iters):
             del cache
             gc.collect()
             cache, _, tpu_tiers, actions, n_tasks = build_config(cfg, scale)
             w = _session_once(cache, tpu_tiers, actions, mesh=mesh)
             samples.append(w["actions_s"] * 1e3)
+            warm_compiles.append(w["profile"].get("compiles", 0))
             if warm is None or w["actions_s"] * 1e3 <= min(samples):
                 warm = w
+        # min is the reproducible figure on a jittery tunneled link, but a
+        # min-only report buries warm-path retraces/stalls — median and max
+        # make the spread (and any hidden recompile) part of the record
+        import statistics
+
         out["tpu_ms"] = min(samples)
+        out["tpu_warm_median_ms"] = round(statistics.median(samples), 3)
+        out["tpu_warm_max_ms"] = round(max(samples), 3)
         out["tpu_warm_samples_ms"] = [round(s, 3) for s in samples]
+        out["tpu_warm_compiles"] = warm_compiles
         out["tpu_binds"] = warm["binds"]
-        out["tpu_profile"] = warm["profile"]
+        out["tpu_profile"] = {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in warm["profile"].items()}
         out["tasks"] = n_tasks
         if verbose:
             p = warm["profile"]
             print(f"[cfg{cfg}] tpu warm: {out['tpu_ms']:.1f} ms "
                   f"(encode {p.get('encode_s', 0)*1e3:.1f} solve {p.get('solve_s', 0)*1e3:.1f} "
                   f"apply {p.get('apply_s', 0)*1e3:.1f}) binds={warm['binds']} "
-                  f"samples={[round(s) for s in samples]}",
+                  f"samples={[round(s) for s in samples]} compiles={warm_compiles}",
                   file=sys.stderr)
 
     if "serial_ms" in out and "tpu_ms" in out and out["tpu_ms"] > 0:
@@ -207,8 +235,13 @@ def main() -> int:
     headline = results[0] if cfgs[0] == 5 else results[-1]
     final = headline_json(headline)
     if len(results) > 1:
+        # tpu_profile (warm per-phase splits incl. pack/dispatch/apply and
+        # the compile counters) stays in the record — the per-hop budget is
+        # part of the result, not debug noise; only the verbose cold
+        # profile is dropped
         final["all_configs"] = [
-            {k: v for k, v in r.items() if not k.endswith("profile")} for r in results
+            {k: v for k, v in r.items() if k != "tpu_cold_profile"}
+            for r in results
         ]
     print(json.dumps(final))
     return 0

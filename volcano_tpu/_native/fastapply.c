@@ -75,8 +75,15 @@ apply_job_tasks(PyObject *self, PyObject *args)
             return NULL;
 
         if (have_s_pending) {
-            if (PyDict_DelItem(s_pending, uid) < 0)
-                PyErr_Clear();                  /* pop(uid, None) */
+            if (PyDict_DelItem(s_pending, uid) < 0) {
+                /* pop(uid, None): only absence is swallowed — any other
+                 * failure (unhashable uid, comparison error) propagates */
+                if (!PyErr_ExceptionMatches(PyExc_KeyError)) {
+                    Py_DECREF(uid);
+                    return NULL;
+                }
+                PyErr_Clear();
+            }
             if (PyDict_SetItem(s_binding_d, uid, task) < 0) {
                 Py_DECREF(uid);
                 return NULL;
@@ -123,8 +130,11 @@ apply_job_tasks(PyObject *self, PyObject *args)
                 if (PyObject_SetAttr(ctask, s_status, binding) < 0)
                     goto fail;
                 if (have_c_pending) {
-                    if (PyDict_DelItem(c_pending, uid) < 0)
+                    if (PyDict_DelItem(c_pending, uid) < 0) {
+                        if (!PyErr_ExceptionMatches(PyExc_KeyError))
+                            goto fail;      /* see s_pending DelItem above */
                         PyErr_Clear();
+                    }
                     if (PyDict_SetItem(c_binding, uid, ctask) < 0)
                         goto fail;
                 }

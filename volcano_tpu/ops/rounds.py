@@ -33,9 +33,15 @@ interleaving is round- rather than visit-grained, overused queues re-enter
 when a rollback drops them below deserved, weighted-DRF NAMESPACE ordering
 is not applied to the job rank (_job_rank keys on tie-rank/priority/gang/
 drf-share only; ns_alloc is tracked in state but does not reorder jobs —
-namespace fairness under contention is round-granular at best), and the
+namespace fairness under contention is round-granular at best), the
 adaptive node-sampling window does not apply (every task sees every node —
-strictly better placements than the reference's sampled serial loop).
+strictly better placements than the reference's sampled serial loop), and
+per-cycle placement count may fall short of the serial oracle by a bounded
+margin: under tight selector/taint contention the bulk rounds can consume
+a constrained node pool with a different task mix than the serial visit
+order, stranding a straggler (retried next cycle). Fuzz-bounded at
+max(2, serial//50) tasks — see tests/test_rounds_scale.py and
+docs/DESIGN.md §3.
 
 Invariants preserved (asserted by tests/test_rounds.py): every placement is
 feasible per the predicate mask and epsilon arithmetic, no node exceeds idle

@@ -139,13 +139,17 @@ def main(argv=None) -> int:
         seed_cluster_state(cluster.store, args.cluster_state)
 
     stop_evt = threading.Event()
+    elector = None
     metrics_srv = ObservabilityServer(args.listen_address).start()
+    # healthz tracks elector liveness too: a dead elector thread means no
+    # scheduler is running even though the process is up
     healthz_srv = ObservabilityServer(
-        args.healthz_address, healthy=lambda: not stop_evt.is_set()).start()
+        args.healthz_address,
+        healthy=lambda: not stop_evt.is_set()
+        and (elector is None or elector.healthy())).start()
     logging.info("metrics on :%d/metrics, healthz on :%d/healthz",
                  metrics_srv.port, healthz_srv.port)
 
-    elector = None
     if args.leader_elect:
         import os
         import socket

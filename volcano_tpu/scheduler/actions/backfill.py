@@ -28,6 +28,12 @@ class BackfillAction(Action):
         view = preemptview.build(ssn)
 
         all_nodes = helper.get_node_list(ssn.nodes)
+        # budget for full per-node diagnostics replay on view-path failures:
+        # each replay costs O(nodes) predicate calls, so only the first few
+        # failed tasks per session get serial-fidelity reasons — a taint
+        # rollout failing thousands of best-effort pods must not turn the
+        # fast dense-view path back into the O(tasks x nodes) sweep
+        replay_budget = 8
         for job in list(ssn.jobs.values()):
             if job.pod_group.status.phase == objects.PodGroupPhase.PENDING:
                 continue
@@ -72,9 +78,22 @@ class BackfillAction(Action):
                     break
                 if not allocated:
                     if view is not None and not fe.nodes:
-                        fe.set_error(
-                            "0/%d nodes are feasible for backfill"
-                            % len(all_nodes) if tried == 0 else
-                            "%d feasible nodes rejected the backfill "
-                            "allocation" % tried)
+                        if tried == 0 and replay_budget > 0:
+                            # dense-view failure path: replay the serial
+                            # predicate chain to recover the per-node
+                            # reasons the serial walk records (bounded by
+                            # replay_budget — see above)
+                            replay_budget -= 1
+                            for nd in all_nodes:
+                                try:
+                                    ssn.predicate_fn(task, nd)
+                                except FitFailure as err:
+                                    fe.set_node_error(
+                                        nd.name, err.fit_error(task, nd))
+                        if not fe.nodes:
+                            fe.set_error(
+                                "0/%d nodes are feasible for backfill"
+                                % len(all_nodes) if tried == 0 else
+                                "%d feasible nodes rejected the backfill "
+                                "allocation" % tried)
                     job.nodes_fit_errors[task.uid] = fe

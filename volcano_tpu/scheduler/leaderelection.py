@@ -160,32 +160,88 @@ class LeaderElector:
             self._thread.join(timeout=self.renew_deadline + 1.0)
 
     def run(self) -> None:
+        last_renew = self._clock()
         try:
             while not self._stop.is_set():
-                if self._try_acquire_or_renew():
+                try:
+                    acquired = self._try_acquire_or_renew()
+                except Exception:
+                    # store hiccups must not kill the elector thread — a
+                    # dead elector is a silently dead leader (healthz keeps
+                    # answering while nothing schedules). While leading and
+                    # still inside the renew deadline, a transient error is
+                    # tolerated exactly like client-go tolerates failed
+                    # renews: keep leading, retry next period. Only past
+                    # the deadline does it count as a lost lease.
+                    logger.exception("leader election cycle failed for %s",
+                                     self.lock.identity)
+                    if (self._leading
+                            and self._clock() - last_renew < self.renew_deadline):
+                        self._stop.wait(self.retry_period)
+                        continue
+                    acquired = False
+                if acquired:
+                    last_renew = self._clock()
                     if not self._leading:
                         logger.info("%s became leader", self.lock.identity)
                         # callback BEFORE publishing is_leader(): an observer
                         # that polls is_leader() must find the workload
-                        # already started. finally-marking keeps run()'s
-                        # cleanup path releasing the lease even when the
-                        # workload callback raises
+                        # already started
                         try:
                             self.on_started_leading()
-                        finally:
-                            self._leading = True
+                        except Exception:
+                            # workload failed to start: tear down whatever
+                            # partially started, release the lease, and step
+                            # down explicitly (never a silently dead leader
+                            # holding the lock), then retry — the standby or
+                            # this candidate re-acquires and restarts the
+                            # workload. Teardown/release are themselves
+                            # guarded: the elector thread survives store
+                            # errors raised while cleaning up.
+                            logger.exception(
+                                "workload start failed for %s; stepping down",
+                                self.lock.identity)
+                            try:
+                                self.on_stopped_leading()
+                            except Exception:
+                                logger.exception(
+                                    "workload teardown failed for %s",
+                                    self.lock.identity)
+                            try:
+                                self._release()
+                            except Exception:
+                                logger.exception(
+                                    "lease release failed for %s",
+                                    self.lock.identity)
+                            self._stop.wait(self.retry_period)
+                            continue
+                        self._leading = True
                     self._stop.wait(self.retry_period)
                 else:
                     if self._leading:
                         self._leading = False
                         logger.info("%s lost leadership", self.lock.identity)
-                        self.on_stopped_leading()
+                        try:
+                            self.on_stopped_leading()
+                        except Exception:
+                            # teardown raising (e.g. during the same store
+                            # outage that cost the lease) must not kill the
+                            # elector: this node keeps contending
+                            logger.exception(
+                                "workload teardown failed for %s",
+                                self.lock.identity)
                     self._stop.wait(self.retry_period)
         finally:
             if self._leading:
                 self._leading = False
                 self._release()
                 self.on_stopped_leading()
+
+    def healthy(self) -> bool:
+        """Elector liveness for healthz: the loop thread (when started) is
+        still running. A crashed elector must flip readiness, not keep
+        serving 200 with no scheduler behind it."""
+        return self._thread is None or self._thread.is_alive()
 
     # -- internals ---------------------------------------------------------
 

@@ -1,0 +1,87 @@
+"""Compile-event accounting for steady-state guarantees.
+
+The scheduler's latency story depends on XLA compiling each program variant
+ONCE: a retrace in a warm session turns a ~100 ms cycle into a multi-second
+stall (the reference never pays anything like this — its hot loop is
+pre-compiled Go — so the rebuild must prove compilation is out of the
+steady-state path). This watcher hooks `jax.monitoring`'s duration events
+and exposes per-window deltas; bench.py records them per session, so any
+warm-path retrace shows up as `compiles > 0` in the BENCH record.
+
+Thread-safe for the single-writer / many-reader pattern JAX uses (listener
+callbacks fire on whichever thread compiles).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+_TRACE = "/jax/core/compile/jaxpr_trace_duration"
+
+
+@dataclass
+class CompileStats:
+    compiles: int = 0
+    compile_s: float = 0.0
+    traces: int = 0
+    trace_s: float = 0.0
+
+
+class CompileWatcher:
+    """Process-global counter of XLA backend compiles + jaxpr traces.
+
+    install() is idempotent; `window()` returns an object whose `delta()`
+    yields the stats accumulated since the window was opened."""
+
+    _instance: "CompileWatcher | None" = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._stats = CompileStats()
+
+    @classmethod
+    def install(cls) -> "CompileWatcher":
+        with cls._lock:
+            if cls._instance is None:
+                inst = cls()
+                from jax._src import monitoring
+
+                def on_duration(event: str, duration: float, **kw) -> None:
+                    if event == _BACKEND_COMPILE:
+                        with inst._mu:
+                            inst._stats.compiles += 1
+                            inst._stats.compile_s += duration
+                    elif event == _TRACE:
+                        with inst._mu:
+                            inst._stats.traces += 1
+                            inst._stats.trace_s += duration
+
+                monitoring.register_event_duration_secs_listener(on_duration)
+                cls._instance = inst
+            return cls._instance
+
+    def snapshot(self) -> CompileStats:
+        with self._mu:
+            return CompileStats(**self._stats.__dict__)
+
+    def window(self) -> "_Window":
+        return _Window(self)
+
+
+class _Window:
+    def __init__(self, watcher: CompileWatcher):
+        self._w = watcher
+        self._base = watcher.snapshot()
+
+    def delta(self) -> CompileStats:
+        now = self._w.snapshot()
+        b = self._base
+        return CompileStats(
+            compiles=now.compiles - b.compiles,
+            compile_s=now.compile_s - b.compile_s,
+            traces=now.traces - b.traces,
+            trace_s=now.trace_s - b.trace_s,
+        )

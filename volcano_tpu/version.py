@@ -18,6 +18,15 @@ __version__ = "0.2.0"
 def _git_sha() -> str:
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     try:
+        # the parent dir is only trustworthy when it IS this repo's checkout:
+        # an install into site-packages nested under some unrelated git
+        # checkout must not report that repo's SHA
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], cwd=repo,
+            capture_output=True, text=True, timeout=5)
+        if (top.returncode != 0
+                or os.path.realpath(top.stdout.strip()) != os.path.realpath(repo)):
+            return ""
         out = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"], cwd=repo,
             capture_output=True, text=True, timeout=5)

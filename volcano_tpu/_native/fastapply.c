@@ -31,20 +31,22 @@ static PyObject *s_node_name, *s_status, *s_uid, *s_namespace, *s_name,
  * s_binding: dict         (session job BINDING bucket)
  * c_tasks / c_pending / c_binding: cache-job analogs (or None)
  * ssn_nodes / cache_nodes: name -> NodeInfo dicts (cache_nodes may be None)
- * bind_tasks / bind_hosts: output lists, appended in task order
+ * bind_tasks / bind_pods / bind_hosts: output lists, appended in task
+ * order (pods pre-extracted here so the binder dispatch needs no 50k
+ * Python-level `.pod` getattrs)
  */
 static PyObject *
 apply_job_tasks(PyObject *self, PyObject *args)
 {
     PyObject *tis, *task_infos, *assign, *node_names, *binding;
     PyObject *s_pending, *s_binding_d, *c_tasks, *c_pending, *c_binding;
-    PyObject *ssn_nodes, *cache_nodes, *bind_tasks, *bind_hosts;
+    PyObject *ssn_nodes, *cache_nodes, *bind_tasks, *bind_pods, *bind_hosts;
 
-    if (!PyArg_ParseTuple(args, "OOOOOOOOOOOOOO",
+    if (!PyArg_ParseTuple(args, "OOOOOOOOOOOOOOO",
                           &tis, &task_infos, &assign, &node_names, &binding,
                           &s_pending, &s_binding_d, &c_tasks, &c_pending,
                           &c_binding, &ssn_nodes, &cache_nodes,
-                          &bind_tasks, &bind_hosts))
+                          &bind_tasks, &bind_pods, &bind_hosts))
         return NULL;
 
     int have_s_pending = s_pending != Py_None;
@@ -158,6 +160,15 @@ apply_job_tasks(PyObject *self, PyObject *args)
 
         if (PyList_Append(bind_tasks, task) < 0)
             goto fail;
+        {
+            PyObject *pod = PyObject_GetAttr(task, s_pod);    /* new */
+            if (pod == NULL)
+                goto fail;
+            int rc = PyList_Append(bind_pods, pod);
+            Py_DECREF(pod);
+            if (rc < 0)
+                goto fail;
+        }
         if (PyList_Append(bind_hosts, host) < 0)
             goto fail;
 

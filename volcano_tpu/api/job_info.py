@@ -162,6 +162,7 @@ class JobInfo:
         # bump _status_version
         self._status_version = 0
         self._ready_cache = None
+        self._valid_cache = None
 
         self.allocated = Resource.empty()
         self.total_request = Resource.empty()
@@ -234,13 +235,38 @@ class JobInfo:
         """Move a task to a new status bucket, keeping the resource
         accounting consistent. A task not currently in the job is simply
         (re-)added under the new status — the reference discards the delete
-        error (job_info.go:232-245) and session code relies on that."""
-        try:
+        error (job_info.go:232-245) and session code relies on that.
+
+        The present-task case fuses delete_task_info + add_task_info: a
+        status flip with a value-equal request leaves total_request
+        unchanged and moves `allocated` only across the allocated-status
+        boundary, so the fused path performs exactly the net Resource ops
+        (and the index bucket move) — identical end state, minus the
+        sub-then-add round trips and their trivially-net-zero sufficiency
+        asserts. Mismatched requests take the legacy path."""
+        stored = self.tasks.get(task.uid)
+        if stored is None:
+            task.status = status
+            self.add_task_info(task)
+            return
+        if stored.resreq != task.resreq:
             self.delete_task_info(task)
-        except KeyError:
-            pass
+            task.status = status
+            self.add_task_info(task)
+            return
+        old_alloc = allocated_status(stored.status)
+        self._delete_task_index(stored)
         task.status = status
-        self.add_task_info(task)
+        new_alloc = allocated_status(status)
+        if old_alloc and not new_alloc:
+            self.allocated.sub(stored.resreq)
+        elif new_alloc and not old_alloc:
+            self.allocated.add(task.resreq)
+        # the incoming object replaces the stored one, as legacy
+        # delete+add does (session code passes clones with independent
+        # status words)
+        self.tasks[task.uid] = task
+        self._add_task_index(task)
 
     # -- readiness math ----------------------------------------------------
 
@@ -261,6 +287,11 @@ class JobInfo:
         return len(self.task_status_index.get(TaskStatus.PIPELINED, {}))
 
     def valid_task_num(self) -> int:
+        # memoized on the status-index version like ready_task_num: the
+        # gang job-valid gate runs per job in every session open/encode
+        cached = self._valid_cache
+        if cached is not None and cached[0] == self._status_version:
+            return cached[1]
         n = 0
         for status, tasks in self.task_status_index.items():
             if (
@@ -270,6 +301,7 @@ class JobInfo:
                 or status == TaskStatus.PENDING
             ):
                 n += len(tasks)
+        self._valid_cache = (self._status_version, n)
         return n
 
     def ready(self) -> bool:

@@ -137,8 +137,51 @@ class NodeInfo:
         del self.tasks[key]
 
     def update_task(self, ti: TaskInfo) -> None:
-        self.remove_task(ti)
-        self.add_task(ti)
+        """remove_task + add_task, fused for the transitions the actions
+        actually perform (evict: allocated->RELEASING, unevict back,
+        pipeline commits). In those the idle/used movements of remove and
+        add cancel exactly and the interleaved sufficiency checks are
+        trivially true (remove just returned the same quantity add takes
+        back), so the fused path applies only the net releasing/idle delta
+        and refreshes the node-owned clone in place — bit-identical end
+        state, minus two Resource deep-copies and two no-op epsilon checks
+        per call. Transitions whose checks are REAL (from PIPELINED, or
+        RELEASING->PIPELINED) and mismatched requests take the legacy
+        remove+add path."""
+        key = pod_key(ti.pod) if ti.pod is not None else f"{ti.namespace}/{ti.name}"
+        cur = self.tasks.get(key)
+        if cur is None:
+            raise RuntimeError(
+                f"failed to find task <{ti.namespace}/{ti.name}> on host <{self.name}>"
+            )
+        old, new = cur.status, ti.status
+        RELEASING, PIPELINED = TaskStatus.RELEASING, TaskStatus.PIPELINED
+        if cur.resreq != ti.resreq or (
+            self.node is not None
+            and (old == PIPELINED or (old == RELEASING and new == PIPELINED))
+        ):
+            self.remove_task(ti)
+            self.add_task(ti)
+            return
+        if self.node is not None and old != new:
+            req = ti.resreq
+            if new == RELEASING and old != RELEASING:
+                self.releasing.add(req)
+            elif old == RELEASING and new != RELEASING:
+                self.releasing.sub(req)
+            elif new == PIPELINED:  # allocated -> PIPELINED
+                self.idle.add(req)
+                self.releasing.sub(req)
+        # in-place refresh of the node-owned clone (remove+add would have
+        # replaced it with ti.clone(); resreq is value-equal by the gate)
+        cur.status = new
+        cur.node_name = ti.node_name
+        cur.priority = ti.priority
+        cur.volume_ready = ti.volume_ready
+        cur.init_resreq = ti.init_resreq  # never mutated via node maps
+        cur.pod = ti.pod
+        cur.row = ti.row
+        cur.row_gen = ti.row_gen
 
     # -- misc --------------------------------------------------------------
 

@@ -190,7 +190,7 @@ def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue):
     if sub.size == 0:
         return None
     job_of_arr = np.asarray(job_of, np.int64)
-    uid = np.array([t.uid for t in all_tasks])
+    uid = g["uid"]  # table-maintained object column; no per-session build
     prio = g["priority"] if prio_on else np.zeros(p_count, np.int64)
     order = np.lexsort(
         (uid[sub], g["ctime"][sub], -prio[sub], job_of_arr[sub]))
@@ -712,10 +712,23 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
     ns_alloc0 = np.zeros((ns_count, R), np.float64)
     ns_weight = np.ones(ns_count, np.float64)
     if drf is not None:
-        for ji, job in enumerate(jobs):
-            attr = drf.job_attrs.get(job.uid)
-            if attr is not None:
-                job_alloc0[ji] = _resource_vec(attr.allocated, rnames)
+        # column-wise fill (one attribute chain per column, not a
+        # per-job _resource_vec array build — J np.array calls dominate
+        # the job axis at 50k-task scale)
+        attrs = [drf.job_attrs.get(job.uid) for job in jobs]
+        allocs = [a.allocated if a is not None else None for a in attrs]
+        if j_count:
+            job_alloc0[:, 0] = [
+                a.milli_cpu if a is not None else 0.0 for a in allocs]
+            job_alloc0[:, 1] = [
+                a.memory if a is not None else 0.0 for a in allocs]
+            has_scalars = any(
+                a is not None and a.scalar_resources for a in allocs)
+            if has_scalars:
+                for si, rn in enumerate(rnames[2:], start=2):
+                    job_alloc0[:, si] = [
+                        (a.scalar_resources or {}).get(rn, 0.0)
+                        if a is not None else 0.0 for a in allocs]
         drf_total = _resource_vec(drf.total_resource, rnames)
         present = {"cpu", "memory", *(drf.total_resource.scalar_resources or {})}
         drf_present = np.array([rn in present for rn in rnames])

@@ -184,8 +184,15 @@ class DensePreemptView:
         self._score_rows: Dict[tuple, list] = {}  # key -> [row, sync_pos]
         self._seen_keys: set = set()
         self._touched: List[int] = []
+        # per-(signature, pod-count-applies) cached SORTED eligible-node
+        # index arrays; same touched-log replay discipline as _score_rows.
+        # Eligibility moves only when a pipeline flips a node's pod-count
+        # headroom, so each repair touches ~1 node instead of re-running
+        # mask & cnt_ok + nonzero over N per candidate stream.
+        self._elig_rows: Dict[tuple, list] = {}  # key -> [idx, sync_pos]
 
     _SCORE_ROW_CAP = 256  # distinct promoted classes per action
+    _ELIG_ROW_CAP = 256
 
     def poison(self) -> None:
         """A pod with (anti-)affinity was PLACED by the serial fallback
@@ -217,7 +224,7 @@ class DensePreemptView:
 
     # -- per-signature static rows ----------------------------------------
 
-    def _rows(self, task) -> Optional[Tuple[np.ndarray, Optional[np.ndarray]]]:
+    def _rows(self, task) -> Optional[Tuple[str, np.ndarray, Optional[np.ndarray]]]:
         if self._poisoned:
             return None
         pod = task.pod
@@ -228,7 +235,7 @@ class DensePreemptView:
             if ones is None:
                 ones = self._sig_mask["<none>"] = np.ones(self.n, bool)
                 self._sig_aff["<none>"] = None
-            return ones, None
+            return "<none>", ones, None
         key, ports, aff = enc_mod._pod_encode_traits(pod)
         if ports or aff:
             return None  # serial fallback for this task
@@ -250,7 +257,7 @@ class DensePreemptView:
                     for nd in self.nodes], np.float64)
             else:
                 self._sig_aff[key] = None
-        return mask, self._sig_aff[key]
+        return key, mask, self._sig_aff[key]
 
     # -- scoring (numpy mirror of kernels.fused_scores) --------------------
 
@@ -384,67 +391,96 @@ class DensePreemptView:
 
     # -- candidate streams -------------------------------------------------
 
-    def _eligible(self, task):
-        """(eligible mask, aff row) for `task`, or None for serial fallback
-        — the signature mask gated by the pod-count feasibility cache."""
+    def _elig_idx(self, task):
+        """(sorted eligible-node index array, aff row) for `task`, or None
+        for serial fallback. The index array (signature mask ∧ pod-count
+        headroom) is cached per signature and repaired from the touched-node
+        log: a pipeline flips eligibility at ONE node, so replaying the log
+        beats re-running mask & cnt_ok + nonzero over N per candidate
+        stream. Callers must treat the array as read-only."""
         rows = self._rows(task)
         if rows is None:
             return None
-        mask, aff = rows
-        if self.check_pod_count and task.pod is not None:
-            mask = mask & self._cnt_ok
-        return mask, aff
+        key, mask, aff = rows
+        use_cnt = self.check_pod_count and task.pod is not None
+        ekey = (key, use_cnt)
+        cached = self._elig_rows.get(ekey)
+        touched = self._touched
+        if cached is None:
+            idx = np.nonzero(mask & self._cnt_ok if use_cnt else mask)[0]
+            if len(self._elig_rows) < self._ELIG_ROW_CAP:
+                self._elig_rows[ekey] = [idx, len(touched)]
+            return idx, aff
+        idx, sync = cached
+        if use_cnt and sync < len(touched):
+            stale = sorted(set(touched[sync:]))
+            if len(stale) > 32:
+                idx = np.nonzero(mask & self._cnt_ok)[0]
+            else:
+                for i in stale:
+                    elig = bool(mask[i]) and bool(self._cnt_ok[i])
+                    pos = int(np.searchsorted(idx, i))
+                    present = pos < idx.size and idx[pos] == i
+                    if elig and not present:
+                        idx = np.insert(idx, pos, i)
+                    elif not elig and present:
+                        idx = np.delete(idx, pos)
+            cached[0] = idx
+        cached[1] = len(touched)
+        return idx, aff
 
-    def candidates(self, task) -> Optional[List]:
+    def candidates(self, task):
         """Feasible nodes for `task` in EXACT serial order: the round-robin
         sampling window of predicate_nodes, then sort_nodes's stable
-        descending-score order. None => caller must run the serial sweep."""
-        rows = self._eligible(task)
+        descending-score order. Returns a LAZY iterator (the consumer
+        usually takes the first workable node; materializing a NodeInfo
+        list per preemptor is pure overhead). None => serial sweep."""
+        rows = self._elig_idx(task)
         if rows is None:
             return None
-        eligible, aff = rows
+        idx, aff = rows
 
         n = self.n
         if n == 0:
-            return []
+            return iter(())
         num_to_find = helper.calculate_num_of_feasible_nodes_to_find(n)
         # reduce the shared cross-cycle cursor mod n up front: after a
         # cluster shrink the raw cursor may exceed n, and predicate_nodes
         # starts at nodes[cursor % n] — the window and the post-advance
         # cursor are identical either way (both arithmetics are mod n)
         rr = helper._last_processed_node_index % n
-        # circular visit order via one nonzero + split at rr (no O(N)
-        # roll/cumsum temporaries — this runs once per preemptor)
-        idx = np.nonzero(eligible)[0]
         split = int(np.searchsorted(idx, rr))
-        visit = np.concatenate([idx[split:], idx[:split]])
-        found_total = len(visit)
+        found_total = idx.size
         if found_total >= num_to_find:
-            sel = visit[:num_to_find]
+            # circular visit order: tail from split, then wrap; slicing
+            # views the cached array (no copy) in the common no-wrap case
+            take_tail = min(num_to_find, found_total - split)
+            sel = idx[split:split + take_tail]
+            if take_tail < num_to_find:
+                sel = np.concatenate([sel, idx[: num_to_find - take_tail]])
             last = int(sel[-1])
             processed = (last - rr) % n + 1
         else:
-            sel = visit
+            sel = np.concatenate([idx[split:], idx[:split]]) if split else idx
             processed = n
         helper._last_processed_node_index = (rr + processed) % n
 
-        if len(sel) == 0:
-            return []
+        if sel.size == 0:
+            return iter(())
         scores = self._score_row(task, aff, sel)
         order = np.argsort(-scores, kind="stable")
-        return [self.nodes[i] for i in sel[order]]
+        return map(self.nodes.__getitem__, sel[order])
 
     def masked_nodes_in_name_order(self, task):
         """Reclaim/backfill candidate stream: feasible nodes in name order
         (the serial walks iterate all nodes; no scoring, no sampling
-        window). Returns a LAZY iterator — backfill normally consumes one
-        element, and materializing ~N NodeInfos per task would cost more
-        than the predicate sweep it replaces. None => serial fallback."""
-        rows = self._eligible(task)
+        window — ascending node index IS name order, node_names is sorted).
+        Returns a LAZY iterator — backfill normally consumes one element.
+        None => serial fallback."""
+        rows = self._elig_idx(task)
         if rows is None:
             return None
-        nodes = self.nodes
-        return (nodes[i] for i in np.nonzero(rows[0])[0])
+        return map(self.nodes.__getitem__, rows[0])
 
     # -- state updates (pipeline is the only op that moves `used`/cnt) -----
 

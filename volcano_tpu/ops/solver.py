@@ -494,6 +494,7 @@ class BatchAllocator:
         gc_was = gc.isenabled()
         gc.disable()
         bind_tasks: list = []
+        bind_pods: list = []
         bind_hosts: list = []
         # native inner loop (volcano_tpu/_native/fastapply.c): identical
         # semantics to the Python body below, which remains the fallback
@@ -557,7 +558,8 @@ class BatchAllocator:
                 if fast is not None:
                     fast(tis, task_infos, assign_l, node_names, BINDING,
                          s_pending, s_binding, c_tasks, c_pending, c_binding,
-                         ssn_nodes, cache_nodes, bind_tasks, bind_hosts)
+                         ssn_nodes, cache_nodes, bind_tasks, bind_pods,
+                         bind_hosts)
                 else:
                     for ti in tis:
                         task = task_infos[ti]
@@ -595,6 +597,7 @@ class BatchAllocator:
                             alloc_vols(task, host)
                             bind_vols(task)
                         bind_tasks.append(task)
+                        bind_pods.append(task.pod)
                         bind_hosts.append(host)
 
                 # PENDING -> BINDING leaves total_request unchanged;
@@ -615,8 +618,9 @@ class BatchAllocator:
         retry_from = None
         if hasattr(binder, "bind_many"):
             try:
-                binder.bind_many(
-                    [(t.pod, h) for t, h in zip(bind_tasks, bind_hosts)])
+                # pods were extracted during the apply loop; zip streams the
+                # pairs without materializing another 50k-tuple list
+                binder.bind_many(zip(bind_pods, bind_hosts))
             except BindManyError as e:
                 retry_from = e.done
             except Exception:

@@ -27,12 +27,16 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
-        from volcano_tpu.ops import preemptview
+        from volcano_tpu.ops import preemptview, victimview
 
         # dense (preemptor x node) feasibility/score rows replace the
         # serial per-task O(nodes) closure sweeps when tpuscore is on;
         # victim selection and Statement authority stay here (SURVEY §7)
         view = preemptview.build(ssn)
+        # batched tiered-intersection victim proposal (ops/victimview.py);
+        # None => every node uses the serial ssn.preemptable dispatch
+        selector = victimview.build(ssn, "preemptable") \
+            if view is not None else None
 
         preemptors_map: Dict[str, PriorityQueue] = {}
         preemptor_tasks: Dict[str, PriorityQueue] = {}
@@ -85,7 +89,7 @@ class PreemptAction(Action):
                         return job.queue == _job.queue and _preemptor.job != task.job
 
                     host = _preempt(ssn, stmt, preemptor, ssn.nodes,
-                                    job_filter, view)
+                                    job_filter, view, selector)
                     if host is not None:
                         assigned = True
                         if view is not None:
@@ -125,7 +129,7 @@ class PreemptAction(Action):
 
                     stmt = ssn.statement()
                     host = _preempt(ssn, stmt, preemptor, ssn.nodes,
-                                    task_filter, view)
+                                    task_filter, view, selector)
                     if host is not None and view is not None:
                         view.on_pipeline(host, preemptor)
                     stmt.commit()
@@ -133,11 +137,13 @@ class PreemptAction(Action):
                         break
 
 
-def _preempt(ssn, stmt, preemptor, nodes, task_filter, view=None):
+def _preempt(ssn, stmt, preemptor, nodes, task_filter, view=None,
+             selector=None):
     """(preempt.go:180-260). Returns the pipelined node name, or None.
 
     With a dense view the candidate stream (feasibility window + score
-    order) comes from vectorized rows; victim selection below is identical
+    order) comes from vectorized rows, and a victim selector batches the
+    tiered plugin intersection; the eviction cut below is identical
     either way."""
     candidates = view.candidates(preemptor) if view is not None else None
     fell_back = candidates is None
@@ -157,7 +163,9 @@ def _preempt(ssn, stmt, preemptor, nodes, task_filter, view=None):
             for task in node.tasks.values()
             if task_filter is None or task_filter(task)
         ]
-        victims = ssn.preemptable(preemptor, preemptees)
+        victims = (selector.victims(preemptor, preemptees)
+                   if selector is not None
+                   else ssn.preemptable(preemptor, preemptees))
         metrics.update_preemption_victims(len(victims))
 
         if not _validate_victims(victims, preemptor.init_resreq):

@@ -28,12 +28,15 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn) -> None:
-        from volcano_tpu.ops import preemptview
+        from volcano_tpu.ops import preemptview, victimview
 
         # dense per-signature feasibility rows replace the per-task O(nodes)
         # predicate closure sweep when tpuscore is on (same candidates, name
-        # order, as reclaim.go's full node walk); victim selection unchanged
+        # order, as reclaim.go's full node walk); the victim selector
+        # batches the tiered Reclaimable intersection on dense nodes
         view = preemptview.build(ssn)
+        selector = victimview.build(ssn, "reclaimable") \
+            if view is not None else None
 
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_set = set()
@@ -103,7 +106,9 @@ class ReclaimAction(Action):
                         continue
                     if j.queue != job.queue:
                         reclaimees.append(t.shared_clone())
-                victims = ssn.reclaimable(task, reclaimees)
+                victims = (selector.victims(task, reclaimees)
+                           if selector is not None
+                           else ssn.reclaimable(task, reclaimees))
                 if not victims:
                     continue
 

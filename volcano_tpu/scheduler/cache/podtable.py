@@ -49,6 +49,11 @@ class PodTable:
         self.flags = np.zeros(cap, np.uint8)
         self.sig_id = np.zeros(cap, np.int32)
         self.gen = np.zeros(cap, np.int64)
+        # uid per row as a ready-made object column: the encoder's task
+        # ordering tie-breaks on uid, and building a 50k-string numpy array
+        # from Python objects every session costs more than the lexsort
+        # itself — here it is maintained incrementally like every column
+        self.uid = np.empty(cap, object)
         self.scalar_cols: Dict[str, np.ndarray] = {}       # resreq scalars
         self.init_scalar_cols: Dict[str, np.ndarray] = {}  # init_resreq
         self._scalar_refs: Dict[str, int] = {}  # live rows using the scalar
@@ -69,6 +74,9 @@ class PodTable:
             grown = np.zeros(new, arr.dtype)
             grown[:old] = arr
             setattr(self, name, grown)
+        uid_grown = np.empty(new, object)
+        uid_grown[:old] = self.uid
+        self.uid = uid_grown
         for cols in (self.scalar_cols, self.init_scalar_cols):
             for rn, col in cols.items():
                 grown = np.zeros(new, col.dtype)
@@ -119,6 +127,7 @@ class PodTable:
                 self._set_scalar(self.init_scalar_cols, row, rn, v)
 
             self._uid_row[task.uid] = row
+            self.uid[row] = task.uid
             task.row = row
             task.row_gen = self._gen_counter
 
@@ -140,6 +149,7 @@ class PodTable:
     def _release_row(self, row: int) -> None:
         self._gen_counter += 1
         self.gen[row] = self._gen_counter  # readers holding old gen fail
+        self.uid[row] = None  # don't pin the uid string until row reuse
         for cols in (self.scalar_cols, self.init_scalar_cols):
             for rn, col in cols.items():
                 if col[row]:
@@ -167,6 +177,7 @@ class PodTable:
             if not np.array_equal(self.gen[rows], gens):
                 return None
             out = {
+                "uid": self.uid[rows],
                 "cpu": self.cpu[rows],
                 "mem": self.mem[rows],
                 "init_cpu": self.init_cpu[rows],

@@ -177,10 +177,12 @@ def main() -> int:
     # sessions, mirroring the loop's between-cycle collections.
     _GC_POLICY = LowLatencyGC.install()
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", type=int, default=None, choices=[1, 2, 3, 4, 5],
-                    help="run ONE config (default: all five, headline = cfg 5)")
+    ap.add_argument("--config", type=int, default=None,
+                    choices=[1, 2, 3, 4, 5, 6],
+                    help="run ONE config (default: all six, headline = cfg 5; "
+                         "cfg6 = cfg2 + affinity/hostPort residue)")
     ap.add_argument("--all", action="store_true",
-                    help="run all five configs (the default when --config is absent)")
+                    help="run all six configs (the default when --config is absent)")
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--backend", choices=["serial", "tpu", "both", "auto"], default="auto")
     ap.add_argument("--serial-budget", type=float, default=30.0,
@@ -224,7 +226,7 @@ def main() -> int:
     # time-boxed harness that kills the run mid-way still captures the
     # headline number in its tail; the combined line (with all_configs)
     # prints last and supersedes it when the run completes
-    cfgs = [args.config] if args.config is not None else [5, 1, 2, 3, 4]
+    cfgs = [args.config] if args.config is not None else [5, 1, 2, 3, 4, 6]
     for cfg in cfgs:
         results.append(run_config(cfg, args.scale, args.backend,
                                   args.serial_budget, mesh=mesh,

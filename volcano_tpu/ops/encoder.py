@@ -142,7 +142,168 @@ def _resource_vec(res: Resource, names: List[str]) -> np.ndarray:
     return np.array([res.get(n) for n in names], np.float64)
 
 
-def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue):
+def _qualifying_anti_terms(pod, batch_on: bool):
+    """The required anti-affinity terms of `pod` IF it is device-placeable
+    as an exclusion group member, else None.
+
+    Qualifying shape (the common "at most one per node" pattern —
+    reference predicates.go:281-299 workloads): every required term has a
+    match_labels-only selector over the pod's own namespace scope with
+    hostname topology, the pod matches its own selectors (so group members
+    mutually exclude), there is no positive pod_affinity, and no preferred
+    pod terms when the InterPodAffinity batch scorer is live (those move
+    node scores, which the device solve would miss)."""
+    aff = pod.spec.affinity
+    if aff is None or aff.pod_anti_affinity is None:
+        return None
+    if aff.pod_affinity is not None:
+        return None
+    anti = aff.pod_anti_affinity
+    if not anti.required_terms:
+        return None
+    if batch_on and anti.preferred_terms:
+        return None
+    labels = pod.metadata.labels
+    for term in anti.required_terms:
+        sel = term.label_selector
+        if sel is None or sel.match_expressions or not sel.match_labels:
+            return None
+        if term.topology_key != "kubernetes.io/hostname":
+            return None
+        if term.namespaces and list(term.namespaces) != [pod.metadata.namespace]:
+            return None
+        if any(labels.get(k) != v for k, v in sel.match_labels.items()):
+            return None  # pod must self-match (mutual exclusion)
+    return anti.required_terms
+
+
+def _single_host_port(pod):
+    """The pod's (host_port, protocol) when it uses exactly ONE, else None
+    (multi-port pods keep the serial residue path — the kernel carries one
+    exclusion group per task)."""
+    ports = [(p.host_port, p.protocol)
+             for c in pod.spec.containers for p in c.ports if p.host_port > 0]
+    return ports[0] if len(ports) == 1 else None
+
+
+def _promote_exclusive(all_tasks, cand_idx, bulk_universe_idx, nodes,
+                       batch_on, port_idx=()):
+    """Try to promote affinity-flagged (and single-hostPort) pending tasks
+    into device-placeable exclusion groups. Returns (gid_of: dict
+    task_index -> group id, occ_rows: list of np.bool_[N] initial
+    occupancy per group).
+
+    A label group (keyed by its canonical term set) is promoted only when
+    EVERY device-bound pending task matching any of its selectors carries
+    the same key — otherwise a plain matcher placed by the bulk solve
+    could land beside a group member without the kernel knowing (the
+    serial residue pass would have seen it as resident). Port groups need
+    no closure: every device-bound user of (port, protocol) is in the
+    group by construction, and multi-port pods stay residue (placed after
+    the bulk, they see device placements as residents). Demotion is always
+    safe: it is exactly today's residue behavior."""
+    # candidate classification
+    keys: dict = {}
+    members: dict = {}
+    terms_of: dict = {}
+    for ti in cand_idx:
+        pod = all_tasks[ti].pod
+        terms = _qualifying_anti_terms(pod, batch_on)
+        if terms is None:
+            continue
+        key = tuple(sorted(
+            (frozenset(t.label_selector.match_labels.items()),
+             pod.metadata.namespace)
+            for t in terms))
+        keys[ti] = key
+        members.setdefault(key, []).append(ti)
+        terms_of.setdefault(key, (pod.metadata.namespace, terms))
+    port_keys: dict = {}
+    for ti in port_idx:
+        pod = all_tasks[ti].pod
+        hp = _single_host_port(pod)
+        if hp is None:
+            continue
+        key = ("port", hp[0], hp[1])
+        port_keys[ti] = key
+        members.setdefault(key, []).append(ti)
+    if not members:
+        return {}, []
+
+    # closure check: label-pair -> device-bound task indices (the plain
+    # bulk set plus every qualifying candidate, INCLUDING port-promoted
+    # pods — they are device-placed too and may carry labels a label
+    # group's selector matches)
+    pair_map: dict = {}
+    universe = set(bulk_universe_idx) | set(keys) | set(port_keys)
+    for ti in universe:
+        pod = all_tasks[ti].pod
+        if pod is None:
+            continue
+        ns = pod.metadata.namespace
+        for k, v in pod.metadata.labels.items():
+            pair_map.setdefault((ns, k, v), []).append(ti)
+    demoted = set()
+    for key, (ns, terms) in terms_of.items():
+        for term in terms:
+            pairs = list(term.label_selector.match_labels.items())
+            cands = pair_map.get((ns, pairs[0][0], pairs[0][1]), [])
+            for ti in cands:
+                pod = all_tasks[ti].pod
+                if any(pod.metadata.labels.get(k) != v for k, v in pairs):
+                    continue
+                if keys.get(ti) != key:
+                    demoted.add(key)
+                    break
+            if key in demoted:
+                break
+    live = [key for key in members
+            if key not in demoted and (key in terms_of or key[0] == "port")]
+    if not live:
+        return {}, []
+
+    # initial occupancy from residents matching a group selector / holding
+    # the group's host port; bail out of promotion wholesale if the scan
+    # would be quadratic-scale
+    n_res = sum(len(nd.tasks) for nd in nodes)
+    if n_res * len(live) > 2_000_000:
+        return {}, []
+    gid = {key: g for g, key in enumerate(live)}
+    occ_rows = [np.zeros(len(nodes), bool) for _ in live]
+    label_live = [k for k in live if k in terms_of]
+    port_live = [(k, gid[k]) for k in live if k not in terms_of]
+    for ni, nd in enumerate(nodes):
+        for t in nd.tasks.values():
+            pod = t.pod
+            if pod is None:
+                continue
+            ns = pod.metadata.namespace
+            labels = pod.metadata.labels
+            for key in label_live:
+                kns, terms = terms_of[key]
+                if ns != kns:
+                    continue
+                for term in terms:
+                    if all(labels.get(k) == v
+                           for k, v in term.label_selector.match_labels.items()):
+                        occ_rows[gid[key]][ni] = True
+                        break
+            if port_live:
+                used = {(p.host_port, p.protocol)
+                        for c in pod.spec.containers
+                        for p in c.ports if p.host_port > 0}
+                if used:
+                    for key, g in port_live:
+                        if (key[1], key[2]) in used:
+                            occ_rows[g][ni] = True
+    gid_of = {ti: gid[key] for ti, key in keys.items() if key in gid}
+    gid_of.update({ti: gid[key] for ti, key in port_keys.items()
+                   if key in gid})
+    return gid_of, occ_rows
+
+
+def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue,
+                    batch_on=False):
     """Columnar task axis: validated gathers from the cache's pod table
     instead of walking task objects. Returns the tuple encode_session
     unpacks, or None to fall back (stale rows, rowless tasks).
@@ -197,6 +358,8 @@ def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue):
     sel = sub[order]  # indices into all_tasks, job-major sorted
 
     residue = ((flags & (FLAG_PORTS | FLAG_AFFINITY)) != 0)[sel]
+    task_excl = None
+    excl_occ_rows: list = []
     if residue.any():
         if not allow_residue:
             # match the object walk's error specificity
@@ -204,15 +367,45 @@ def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue):
             if flags[first] & FLAG_AFFINITY:
                 raise EncoderFallback("pod (anti-)affinity not modeled")
             raise EncoderFallback("host ports not modeled")
-        keep = sel[~residue]
+        # exclusion-group promotion: qualifying required-anti-affinity pods
+        # (hostname topology, self-matching match_labels selectors) place
+        # ON DEVICE under a per-(group, node) occupancy constraint instead
+        # of the serial residue pass; ports / non-qualifying shapes remain
+        # residue (FLAG_PORTS also set => stays residue: ports are live-
+        # checked only serially)
+        aff_only = ((flags[sel] & FLAG_AFFINITY) != 0) & \
+            ((flags[sel] & FLAG_PORTS) == 0) & residue
+        ports_only = ((flags[sel] & FLAG_PORTS) != 0) & \
+            ((flags[sel] & FLAG_AFFINITY) == 0) & residue
+        cand_idx = [int(sel[i]) for i in np.nonzero(aff_only)[0]]
+        port_idx = [int(sel[i]) for i in np.nonzero(ports_only)[0]]
+        keep_plain = [int(sel[i]) for i in np.nonzero(~residue)[0]]
+        gid_of, excl_occ_rows = _promote_exclusive(
+            all_tasks, cand_idx, keep_plain, nodes, batch_on,
+            port_idx=port_idx)
+        keep_mask = ~residue
+        if gid_of:
+            # vectorized promotion lookup: a per-task-id gid table beats
+            # ~2 x O(T) Python dict probes on the columnar path
+            gid_table = np.full(p_count, -1, np.int32)
+            for ti, grp in gid_of.items():
+                gid_table[ti] = grp
+            keep_mask = keep_mask | (gid_table[sel] >= 0)
+            keep = sel[keep_mask]
+            task_excl = gid_table[keep]
+        else:
+            keep = sel[keep_mask]
+            task_excl = np.full(keep.size, -1, np.int32)
         job_residue = np.bincount(
-            job_of_arr[sel[residue]], minlength=j_count).astype(np.int32)
+            job_of_arr[sel[~keep_mask]], minlength=j_count).astype(np.int32)
     else:
         keep = sel
         job_residue = np.zeros(j_count, np.int32)
 
     task_infos = [all_tasks[i] for i in keep]
     t_count = len(task_infos)
+    if task_excl is None:
+        task_excl = np.full(t_count, -1, np.int32)
 
     # session signature ids from table-global ids (numbering differs from
     # the object walk's first-encounter order; content is identical)
@@ -241,7 +434,7 @@ def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue):
 
     return (rnames, task_infos, sig_rep, task_sig_arr,
             job_task_start, job_task_count, job_residue,
-            task_req, task_initreq)
+            task_req, task_initreq, task_excl, excl_occ_rows)
 
 
 def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
@@ -354,12 +547,14 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
     fast = None
     if table is not None and not sym_active and task_order_plugins <= {"priority"}:
         fast = _fast_task_axis(
-            jobs, j_count, nodes, table, bool(task_order_plugins), allow_residue)
+            jobs, j_count, nodes, table, bool(task_order_plugins),
+            allow_residue, batch_on="nodeorder" in batch_order)
 
+    excl_occ_rows: list = []
     if fast is not None:
         (rnames, task_infos, sig_rep, task_sig_arr,
          job_task_start, job_task_count, job_residue,
-         task_req, task_initreq) = fast
+         task_req, task_initreq, task_excl, excl_occ_rows) = fast
         R = len(rnames)
         t_count = len(task_infos)
         s_count = max(len(sig_rep), 1)
@@ -491,6 +686,10 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
             if task_infos else np.zeros(0, bool)
         task_sig_arr = (np.array(task_sig, np.int32)
                         if task_sig else np.zeros(0, np.int32))
+        # the object walk (stale rows / custom task order / live symmetry
+        # terms) never promotes exclusion groups — affinity tasks remain
+        # residue exactly as before
+        task_excl = np.full(t_count, -1, np.int32)
 
     eps = np.array(
         [MIN_MILLI_CPU, MIN_MEMORY] + [MIN_MILLI_SCALAR] * (R - 2), np.float64
@@ -514,10 +713,13 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
         cls_key = np.ascontiguousarray(np.concatenate(
             [task_req, task_initreq,
              task_sig_arr[:, None].astype(np.float64),
-             task_has_pod[:, None].astype(np.float64)], axis=1))
+             task_has_pod[:, None].astype(np.float64),
+             task_excl[:, None].astype(np.float64)], axis=1))
         # byte-view unique: one memcmp sort instead of np.unique(axis=0)'s
         # per-column lexsort; byte equality == value equality here (all
-        # finite, non-negative floats), and class IDs carry no semantics
+        # finite floats), and class IDs carry no semantics. The exclusion
+        # group id is part of the key so each group gets its own class and
+        # the kernel's per-class node masks can carry group occupancy.
         row_bytes = cls_key.view(
             np.dtype((np.void, cls_key.dtype.itemsize * cls_key.shape[1]))
         ).ravel()
@@ -528,6 +730,7 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
         k_count = cls_rows.shape[0]
         cls_req = cls_rows[:, :R]
         cls_initreq = cls_rows[:, R:2 * R]
+        cls_excl = cls_rows[:, 2 * R + 2].astype(np.int32)
         cls_sig = cls_rows[:, 2 * R].astype(np.int32)
         cls_has_pod = cls_rows[:, 2 * R + 1] != 0
         cls_nz_cpu = np.where(cls_req[:, 0] != 0, cls_req[:, 0],
@@ -541,6 +744,7 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
         cls_initreq = np.zeros((1, R), np.float64)
         cls_sig = np.zeros(1, np.int32)
         cls_has_pod = np.zeros(1, bool)
+        cls_excl = np.full(1, -1, np.int32)
         cls_nz_cpu = np.full(1, nodeorder_mod.DEFAULT_MILLI_CPU_REQUEST)
         cls_nz_mem = np.full(1, nodeorder_mod.DEFAULT_MEMORY_REQUEST)
 
@@ -763,6 +967,10 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
     balanced_weight = float(no_args.get_int(nodeorder_mod.BALANCED_RESOURCE_WEIGHT, 1))
     node_affinity_weight = float(no_args.get_int(nodeorder_mod.NODE_AFFINITY_WEIGHT, 1))
 
+    g_count = max(len(excl_occ_rows), 1)
+    excl_occ0 = (np.stack(excl_occ_rows) if excl_occ_rows
+                 else np.zeros((1, n_count), bool))
+
     spec = SolveSpec(
         job_order_keys=tuple(job_order),
         use_drf_ns_order=bool(ns_order),
@@ -771,7 +979,7 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
         check_pod_count=check_pod_count,
         use_binpack=use_binpack,
         use_nodeorder=use_nodeorder,
-        max_visits=ns_count + j_count + t_count + 8,
+        use_exclusion=bool(excl_occ_rows),
     )
 
     arrays = dict(
@@ -791,6 +999,8 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
         cls_nz_mem=cls_nz_mem,
         cls_sig=cls_sig,
         cls_has_pod=cls_has_pod,
+        cls_excl=cls_excl,
+        excl_occ0=excl_occ0,
         task_job=np.repeat(
             np.arange(j_count, dtype=np.int32), job_task_count
         ) if t_count else np.zeros(0, np.int32),

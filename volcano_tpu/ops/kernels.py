@@ -127,7 +127,9 @@ class SolveSpec(NamedTuple):
     check_pod_count: bool
     use_binpack: bool
     use_nodeorder: bool
-    max_visits: int
+    # rounds-only: device-placed required-anti-affinity exclusion groups
+    # (encoder._promote_exclusive); flips only when such workloads appear
+    use_exclusion: bool = False
 
 
 def fused_scores(spec: SolveSpec, enc, used, req, nz_cpu, nz_mem, sig):
@@ -376,8 +378,16 @@ def solve_allocate(spec: SolveSpec, enc: dict, rr0, num_to_find):
 
     visit = _make_visit(spec, enc)
 
+    # runaway backstop derived from the PADDED shapes, not the live counts:
+    # a live count in the static spec would retrace the program every time
+    # the cluster churned by one task (the churn-soak steady-state retrace);
+    # padding only ever raises the bound, and the loop's real exit is the
+    # ns_active drain
+    max_visits = (enc["ns_active0"].shape[0]
+                  + enc["job_task_start"].shape[0] + T + 8)
+
     def cond(st):
-        return jnp.any(st["ns_active"]) & (st["visits"] < spec.max_visits)
+        return jnp.any(st["ns_active"]) & (st["visits"] < max_visits)
 
     st = lax.while_loop(cond, visit, st)
     return st["assign"], st["rr"]

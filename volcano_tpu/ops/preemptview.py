@@ -54,13 +54,27 @@ def build(ssn) -> Optional["DensePreemptView"]:
         return None
 
 
+def build_alloc_assist(ssn) -> Optional["DensePreemptView"]:
+    """Allocate-residue variant: tolerates resident pods with REQUIRED
+    (anti-)affinity terms (feasibility comes from the live residual chain,
+    not cached masks) and additionally tracks node idle/releasing for the
+    vectorized resource-fit window. None => fully serial residue pass."""
+    if getattr(ssn, "batch_allocator", None) is None:
+        return None
+    try:
+        return DensePreemptView(ssn, for_allocate=True)
+    except _Unsupported:
+        return None
+
+
 class _Unsupported(Exception):
     pass
 
 
 class DensePreemptView:
-    def __init__(self, ssn):
+    def __init__(self, ssn, for_allocate: bool = False):
         self.ssn = ssn
+        self.for_allocate = for_allocate
 
         # capability gates mirror the encoder's: only the stock predicates /
         # nodeorder / binpack contribute to the vectorized rows
@@ -87,17 +101,30 @@ class DensePreemptView:
 
         # resident pods with (anti-)affinity make candidate masks/scores
         # depend on pairwise label matching: anti-affinity symmetry changes
-        # feasibility, and pod_affinity terms feed nodeorder's
-        # InterPodAffinity batch score — both un-modeled here, so the whole
-        # view falls back (the serial predicates/nodeorder path handles
-        # them; rare in preemption scenarios)
+        # feasibility, and PREFERRED pod_affinity terms feed nodeorder's
+        # InterPodAffinity batch score. Preempt/reclaim/backfill views fall
+        # back entirely (their cached masks would go stale); the allocate
+        # assist tolerates REQUIRED-only terms — feasibility is re-checked
+        # live by the residual chain per candidate — and bails only when a
+        # resident's preferred terms could move the batch score
+        batch_on = "nodeorder" in batch_order
+        self._batch_on = batch_on
         for node in self.nodes:
             for t in node.tasks.values():
                 pod = t.pod
                 if pod is not None and pod.spec.affinity is not None and (
                         pod.spec.affinity.pod_affinity is not None
                         or pod.spec.affinity.pod_anti_affinity is not None):
-                    raise _Unsupported("resident pod (anti-)affinity")
+                    if not for_allocate:
+                        raise _Unsupported("resident pod (anti-)affinity")
+                    aff = pod.spec.affinity
+                    if batch_on and (
+                            (aff.pod_affinity is not None
+                             and aff.pod_affinity.preferred_terms)
+                            or (aff.pod_anti_affinity is not None
+                                and aff.pod_anti_affinity.preferred_terms)):
+                        raise _Unsupported(
+                            "resident preferred pod-affinity terms")
 
         # resource axis: cpu/memory + scalars seen on nodes OR requested by
         # pending tasks — a requested-but-absent scalar must still sit in
@@ -127,6 +154,17 @@ class DensePreemptView:
 
         self.alloc = mat("allocatable")
         self.used = mat("used")
+        if for_allocate:
+            # exact mirrors of node.idle / node.releasing, updated by the
+            # alloc hooks with the same per-dim +=/-= sequence Resource
+            # arithmetic performs, so verdicts stay bit-identical
+            self.idle = mat("idle")
+            self.rel = mat("releasing")
+            self._eps = np.array(
+                [10.0, 10.0 * 1024 * 1024] + [10.0] * (len(self.rnames) - 2),
+                np.float64)  # MIN_MILLI_CPU / MIN_MEMORY / MIN_MILLI_SCALAR
+            self._is_scalar = np.array(
+                [False, False] + [True] * (len(self.rnames) - 2))
         self.cnt = np.array([len(nd.tasks) for nd in self.nodes], np.int64)
         self.max_tasks = np.array(
             [nd.allocatable.max_task_num for nd in self.nodes], np.int64)
@@ -237,8 +275,11 @@ class DensePreemptView:
                 self._sig_aff["<none>"] = None
             return "<none>", ones, None
         key, ports, aff = enc_mod._pod_encode_traits(pod)
-        if ports or aff:
-            return None  # serial fallback for this task
+        if (ports or aff) and not self.for_allocate:
+            # preempt/reclaim/backfill views have no residual hook — the
+            # serial sweep handles traited tasks; the allocate assist
+            # checks ports/affinity live per candidate instead
+            return None
         mask = self._sig_mask.get(key)
         if mask is None:
             if self.predicates_on:
@@ -501,3 +542,117 @@ class DensePreemptView:
 
     def on_unpipeline(self, node_name: str, task) -> None:
         self._node_delta(node_name, task, -1)
+
+    # -- allocate-assist surface (for_allocate views only) -----------------
+
+    def _req_vec(self, res) -> np.ndarray:
+        v = np.zeros(len(self.rnames), np.float64)
+        v[0] = res.milli_cpu
+        v[1] = res.memory
+        for si, rn in enumerate(self.rnames[2:], start=2):
+            v[si] = (res.scalar_resources or {}).get(rn, 0.0)
+        return v
+
+    def alloc_best_node(self, task, residual=None):
+        """Serial-parity predicate window + prioritize + select for the
+        allocate residue pass: the round-robin window over nodes passing
+        signature mask ∧ pod-count ∧ epsilon resource fit (idle OR
+        releasing) ∧ the live `residual` check (ports/affinity), then the
+        cached score rows and select_best_node's max-score/min-name pick.
+
+        Returns the chosen NodeInfo, or None when the caller must run the
+        legacy sweep — unsupported task, or ZERO feasible nodes (the
+        cursor is left unadvanced then; the legacy rerun advances it by
+        exactly the full circle, which is what the serial path does)."""
+        if not self.for_allocate or self._poisoned:
+            return None
+        pod = task.pod
+        if pod is not None and self._batch_on and pod.spec.affinity is not None:
+            aff = pod.spec.affinity
+            if ((aff.pod_affinity is not None
+                 and aff.pod_affinity.preferred_terms)
+                    or (aff.pod_anti_affinity is not None
+                        and aff.pod_anti_affinity.preferred_terms)):
+                return None  # incoming preferred terms move the batch score
+        res = self._elig_idx(task)
+        if res is None:
+            return None
+        idx, aff_row = res
+        n = self.n
+        if n == 0 or idx.size == 0:
+            return None
+        # epsilon resource fit (Resource.less_equal arithmetic) against
+        # idle OR releasing, vectorized over the sig∧cnt-eligible subset
+        req = self._req_vec(task.init_resreq)
+        skip = self._is_scalar & (req <= 10.0)
+        fit_idle = ((req[None, :] < self.idle[idx] + self._eps[None, :])
+                    | skip[None, :]).all(axis=1)
+        fit_rel = ((req[None, :] < self.rel[idx] + self._eps[None, :])
+                   | skip[None, :]).all(axis=1)
+        cand = idx[fit_idle | fit_rel]
+        if cand.size == 0:
+            return None
+        num_to_find = helper.calculate_num_of_feasible_nodes_to_find(n)
+        rr = helper._last_processed_node_index % n
+        split = int(np.searchsorted(cand, rr))
+        if residual is None:
+            total = cand.size
+            if total >= num_to_find:
+                take_tail = min(num_to_find, total - split)
+                found = cand[split:split + take_tail]
+                if take_tail < num_to_find:
+                    found = np.concatenate(
+                        [found, cand[: num_to_find - take_tail]])
+                processed = (int(found[-1]) - rr) % n + 1
+            else:
+                found = np.concatenate([cand[split:], cand[:split]]) \
+                    if split else cand
+                processed = n
+        else:
+            nodes = self.nodes
+            found_l = []
+            last = -1
+            for i in np.concatenate([cand[split:], cand[:split]]).tolist():
+                if residual(nodes[i]):
+                    found_l.append(i)
+                    if len(found_l) >= num_to_find:
+                        last = i
+                        break
+            if not found_l:
+                return None  # cursor untouched; legacy does the full scan
+            processed = ((last - rr) % n + 1) if last >= 0 else n
+            found = np.asarray(found_l, np.int64)
+        if found.size == 0:
+            return None
+        helper._last_processed_node_index = (rr + processed) % n
+        scores = self._score_row(task, aff_row, found)
+        m = scores.max()
+        best = int(found[scores == m].min())  # select_best_node tie-break
+        return self.nodes[best]
+
+    def _alloc_delta(self, node_name: str, task, sign: int,
+                     pipelined: bool) -> None:
+        i = self._node_idx.get(node_name)
+        if i is None:
+            return
+        req = self._req_vec(task.resreq)
+        if pipelined:
+            self.rel[i] -= sign * req  # placement onto releasing capacity
+        else:
+            self.idle[i] -= sign * req
+        self.used[i] += sign * req
+        self.cnt[i] += sign
+        self._cnt_ok[i] = self.cnt[i] < self.max_tasks[i]
+        self._touched.append(i)
+
+    def on_allocate(self, node_name: str, task) -> None:
+        self._alloc_delta(node_name, task, 1, pipelined=False)
+
+    def on_unallocate(self, node_name: str, task) -> None:
+        self._alloc_delta(node_name, task, -1, pipelined=False)
+
+    def on_pipeline_alloc(self, node_name: str, task) -> None:
+        self._alloc_delta(node_name, task, 1, pipelined=True)
+
+    def on_unpipeline_alloc(self, node_name: str, task) -> None:
+        self._alloc_delta(node_name, task, -1, pipelined=True)

@@ -64,7 +64,7 @@ from volcano_tpu.ops.kernels import (
     fused_scores,
 )
 
-CHUNK = 1024
+CHUNK = 128
 
 
 def _job_rank(spec: SolveSpec, enc, job_placed, job_alloc):
@@ -84,7 +84,7 @@ def _job_rank(spec: SolveSpec, enc, job_placed, job_alloc):
     return jnp.zeros(j, jnp.int32).at[order].set(jnp.arange(j, dtype=jnp.int32))
 
 
-def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
+def _choices(spec: SolveSpec, enc, idle, used, cnt, active, excl_occ=None):
     """Per-task node choice via task equivalence classes.
 
     Tasks stamped from one template share (req, initreq, signature,
@@ -146,6 +146,13 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
             if spec.check_pod_count:
                 mask = mask & ((cnt[None, :] < enc["node_max_tasks"][None, :])
                                | ~has_pod[:, None])
+            if spec.use_exclusion:
+                # exclusion-group classes: nodes already holding a group
+                # member (resident at encode, or committed in an earlier
+                # round) are infeasible for the whole class
+                exl = lax.dynamic_slice_in_dim(enc["cls_excl"], sl, chunk)
+                occ = excl_occ[jnp.maximum(exl, 0)]              # [C, N]
+                mask = mask & ~(occ & (exl >= 0)[:, None])
 
             score = fused_scores(spec, enc, used, req, nz_cpu, nz_mem, sig)
             masked = jnp.where(mask, score, neg)
@@ -174,6 +181,10 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
             if spec.use_binpack:
                 frac = lax.dynamic_slice_in_dim(cls_frac, sl, chunk)
                 cap = cap * frac[:, None]
+            if spec.use_exclusion:
+                # at most one group member per node, ever
+                cap = jnp.where((exl >= 0)[:, None],
+                                jnp.minimum(cap, 1.0), cap)
             if spec.check_pod_count:
                 pod_room = (enc["node_max_tasks"] - cnt)[None, :].astype(cap.dtype)
                 cap = jnp.where(has_pod[:, None],
@@ -261,7 +272,7 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
     overflow = slot >= n_feas[task_cls]
     slot = jnp.clip(slot, 0, n_total - 1)
     tk = task_cls
-    if spec.use_binpack:
+    if spec.use_binpack and not spec.use_exclusion:
         # packing policy: serial binpack breaks round-start ties TOWARD the
         # node it just filled (fill one node, then the next); the
         # sequential capacity walk reproduces that — no rotation
@@ -272,7 +283,16 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active):
         gs = g_start[tk, slot]
         gz = jnp.maximum(g_size[tk, slot], 1)
         local = rank - ccap_before[tk, slot]
-        final = gs + (jnp.maximum(local, 0) % gz)
+        rotated = gs + (jnp.maximum(local, 0) % gz)
+        if spec.use_binpack:
+            # exclusion classes are capped at one member per node, so the
+            # packing walk would aim every group at the same first nodes
+            # and bounce all but one per round (convergence crawl); rotate
+            # THEM within tied groups, keep true packing for the rest
+            is_excl = enc["cls_excl"][tk] >= 0
+            final = jnp.where(is_excl, rotated, slot)
+        else:
+            final = rotated
     choice = order[tk, final]
     feasible = (n_feas[tk] > 0) & ~overflow & active
     # conservative retry choice: each task's class-best feasible node (the
@@ -480,6 +500,8 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         task_req=enc["cls_req"][enc["task_cls"]],
         task_has_pod=enc["cls_has_pod"][enc["task_cls"]],
     )
+    task_excl = (enc["cls_excl"][enc["task_cls"]]
+                 if spec.use_exclusion else None)
 
     task_job = enc["task_job"]
     task_queue = enc["job_queue"][task_job]
@@ -507,6 +529,8 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         tried_cons=jnp.bool_(False),  # conservative retry owed after stall
         dead=jnp.bool_(False),  # outer fixpoint reached
     )
+    if spec.use_exclusion:
+        st["excl_occ"] = enc["excl_occ0"]
     # stall pairs cost two rounds per placement or rollback in the worst
     # case, so the runaway bound is 2(T+J)+8 (see outer_body)
     round_budget = 2 * (t_total + j_total) + 8
@@ -531,8 +555,26 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         # the loop exits to the rollback fixpoint.
         cons = ~st["progress"]
         choice, cons_choice = _choices(
-            spec, enc, st["idle"], st["used"], st["cnt"], active)
+            spec, enc, st["idle"], st["used"], st["cnt"], active,
+            excl_occ=st.get("excl_occ"))
         choice = jnp.where(cons, cons_choice, choice)
+        if spec.use_exclusion:
+            # within-round mutual exclusion: of the tasks of one group
+            # aimed at one node this round, only the best-ranked proceeds;
+            # the rest retry next round against the updated occupancy.
+            # Winner-per-(group, node) via scatter-min of the task rank —
+            # ranks are unique, so equality identifies exactly one winner
+            # (a lexsort here costs several ms per round on host backends)
+            n_nodes = st["idle"].shape[0]
+            isx = (task_excl >= 0) & (choice >= 0)
+            g_idx = jnp.maximum(task_excl, 0)
+            n_idx = jnp.clip(choice, 0, n_nodes - 1)
+            big = jnp.int32(2**30)
+            winner = jnp.full(
+                (enc["excl_occ0"].shape[0], n_nodes), big, jnp.int32
+            ).at[g_idx, n_idx].min(jnp.where(isx, task_rank, big))
+            keepm = ~isx | (task_rank == winner[g_idx, n_idx])
+            choice = jnp.where(keepm, choice, -1)
         accept = _resolve(spec, enc, st["idle"], st["cnt"], choice, task_rank)
         if spec.use_prop_overused:
             accept = _queue_budget(enc, st["queue_alloc"], accept,
@@ -545,6 +587,10 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         cnt = st["cnt"].at[node].add(accept.astype(jnp.int32))
         assign = jnp.where(accept, choice, st["assign"])
         any_accept = jnp.any(accept)
+        if spec.use_exclusion:
+            st = dict(st, excl_occ=st["excl_occ"].at[
+                jnp.maximum(task_excl, 0), node].max(
+                    accept & (task_excl >= 0)))
         return dict(
             st,
             idle=idle, used=used, cnt=cnt, assign=assign,
@@ -572,6 +618,12 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         node = jnp.clip(st["assign"], 0, st["idle"].shape[0] - 1)
         dreq = jnp.where(roll[:, None], enc["task_req"], 0.0).astype(dt)
         dead_task = roll_job[task_job]  # the job leaves the session's queue
+        if spec.use_exclusion:
+            # free the rolled members' group slots (one holder per
+            # (group, node), so the scatter cannot collide)
+            st = dict(st, excl_occ=st["excl_occ"].at[
+                jnp.maximum(task_excl, 0), node].min(
+                    ~(roll & (task_excl >= 0))))
         return dict(
             st,
             idle=st["idle"].at[node].add(dreq),

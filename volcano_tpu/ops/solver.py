@@ -52,7 +52,7 @@ ROUNDS_SAFE_PLUGINS = frozenset({
 })
 
 _NODE_AXIS = {
-    "sig_mask": 1, "affinity_score": 1,
+    "sig_mask": 1, "affinity_score": 1, "excl_occ0": 1,
     "node_idle": 0, "node_used": 0, "node_alloc": 0,
     "node_cnt": 0, "node_max_tasks": 0, "node_real": 0,
 }
@@ -82,6 +82,10 @@ def pad_encoded(enc: EncodedSnapshot, node_multiple: int = 1) -> Dict[str, np.nd
                  "cls_sig", "cls_has_pod"):
         a[name] = _pad_axis(a[name], 0, kb,
                             fill=False if name == "cls_has_pod" else 0)
+    a["cls_excl"] = _pad_axis(a["cls_excl"], 0, kb, fill=-1)
+    # exclusion-group axis buckets so group-count churn cannot retrace
+    gb = _bucket(a["excl_occ0"].shape[0])
+    a["excl_occ0"] = _pad_axis(a["excl_occ0"], 0, gb, fill=False)
     for name in (
         "job_task_start", "job_task_count", "job_queue", "job_ns",
         "job_priority", "job_min_available", "job_ready_base",
@@ -111,7 +115,8 @@ for _g, _names in {
     "node": ("node_alloc", "node_max_tasks"),
     "sig": ("sig_mask", "affinity_score"),
     "cls": ("cls_req", "cls_initreq", "cls_nz_cpu", "cls_nz_mem",
-            "cls_sig", "cls_has_pod"),
+            "cls_sig", "cls_has_pod", "cls_excl"),
+    "sigx": ("excl_occ0",),
     "task": ("task_cls", "task_job"),
     "job": ("job_task_start", "job_task_count", "job_queue", "job_ns",
             "job_priority", "job_min_available", "job_ready_threshold",
@@ -644,6 +649,20 @@ class BatchAllocator:
                  f"Successfully assigned "
                  f"{task.namespace}/{task.name} to {host}")
                 for task, host in zip(bind_tasks, bind_hosts))
+
+        if enc.spec.use_exclusion:
+            # device-placed exclusion-group pods carry required
+            # anti-affinity: later serial phases (residue, backfill,
+            # preempt) must see them in the predicates plugin's resident
+            # index, which the bulk writeback's event bypass would miss
+            pred = ssn.plugins.get("predicates")
+            note = getattr(pred, "note_resident", None)
+            if note is not None:
+                from volcano_tpu.api.pod_traits import has_pod_affinity
+
+                for task in bind_tasks:
+                    if task.pod is not None and has_pod_affinity(task.pod):
+                        note(task)
 
         self.profile["apply_bind_s"] = time.perf_counter() - prof_t2
         prof_t3 = time.perf_counter()

@@ -45,15 +45,21 @@ class AllocateAction(Action):
                 # (pod affinity, host ports) are still PENDING, and nodes
                 # with releasing capacity can still pipeline leftovers; the
                 # serial loop picks up exactly the remaining pending tasks
-                # on post-bulk state with full predicate fidelity
+                # on post-bulk state with full predicate fidelity. The dense
+                # alloc assist (vectorized window + cached score rows, live
+                # residual affinity/ports checks) replaces the per-node
+                # closure sweeps with bit-identical selections.
+                from volcano_tpu.ops import preemptview
+
                 logger.info(
                     "allocate: serial residue pass (%d residue tasks, "
                     "%d unplaced)", residue, unplaced)
-                self._serial_execute(ssn)
+                self._serial_execute(
+                    ssn, assist=preemptview.build_alloc_assist(ssn))
             return
         self._serial_execute(ssn)
 
-    def _serial_execute(self, ssn) -> None:
+    def _serial_execute(self, ssn, assist=None) -> None:
         namespaces = PriorityQueue(ssn.namespace_order_fn)
         # namespace -> queue -> job PQ
         jobs_map: Dict[str, Dict[str, PriorityQueue]] = {}
@@ -87,6 +93,26 @@ class AllocateAction(Action):
                 raise FitFailure(NODE_RESOURCE_FIT_FAILED)
             ssn.predicate_fn(task, node)
 
+        predicates = ssn.plugins.get("predicates") if assist is not None else None
+
+        def _residual_for(task):
+            """Live ports/affinity check closure for the assist's window,
+            or None when the base mask already decides everything."""
+            if predicates is None or not hasattr(predicates, "needs_residual"):
+                return None
+            if not predicates.needs_residual(task.pod):
+                return None
+            check = predicates.residual_check
+
+            def residual(node) -> bool:
+                try:
+                    check(task, node)
+                except FitFailure:
+                    return False
+                return True
+
+            return residual
+
         while not namespaces.empty():
             namespace = namespaces.pop()
             queue_in_namespace = jobs_map[namespace]
@@ -118,6 +144,7 @@ class AllocateAction(Action):
             tasks = pending_tasks[job.uid]
 
             stmt = ssn.statement()
+            stmt_ops = []  # (hook_undo_kind, host, task) for assist unwind
 
             while not tasks.empty():
                 task: TaskInfo = tasks.pop()
@@ -125,21 +152,31 @@ class AllocateAction(Action):
                 if job.nodes_fit_delta:
                     job.nodes_fit_delta = {}
 
-                found_nodes, fit_errors = helper.predicate_nodes(task, all_nodes, predicate_fn)
-                if not found_nodes:
-                    job.nodes_fit_errors[task.uid] = fit_errors
-                    break
+                node = None
+                if assist is not None:
+                    node = assist.alloc_best_node(task, _residual_for(task))
+                if node is None:
+                    found_nodes, fit_errors = helper.predicate_nodes(
+                        task, all_nodes, predicate_fn)
+                    if not found_nodes:
+                        job.nodes_fit_errors[task.uid] = fit_errors
+                        break
 
-                node_scores = helper.prioritize_nodes(
-                    task, found_nodes,
-                    ssn.batch_node_order_fn, ssn.node_order_map_fn, ssn.node_order_reduce_fn)
-                node = helper.select_best_node(node_scores)
+                    node_scores = helper.prioritize_nodes(
+                        task, found_nodes,
+                        ssn.batch_node_order_fn, ssn.node_order_map_fn,
+                        ssn.node_order_reduce_fn)
+                    node = helper.select_best_node(node_scores)
 
                 if task.init_resreq.less_equal(node.idle):
                     try:
                         stmt.allocate(task, node.name)
                     except (KeyError, RuntimeError) as e:
                         logger.error("Failed to bind Task %s on %s: %s", task.uid, node.name, e)
+                    else:
+                        if assist is not None:
+                            assist.on_allocate(node.name, task)
+                            stmt_ops.append(("alloc", node.name, task))
                 else:
                     # record the shortfall, then try releasing resources
                     delta = node.idle.clone()
@@ -147,6 +184,9 @@ class AllocateAction(Action):
                     job.nodes_fit_delta[node.name] = delta
                     if task.init_resreq.less_equal(node.releasing):
                         stmt.pipeline(task, node.name)
+                        if assist is not None:
+                            assist.on_pipeline_alloc(node.name, task)
+                            stmt_ops.append(("pipe", node.name, task))
 
                 if ssn.job_ready(job):
                     jobs.push(job)
@@ -156,5 +196,12 @@ class AllocateAction(Action):
                 stmt.commit()
             else:
                 stmt.discard()
+                if assist is not None:
+                    # mirror the statement rollback in the assist's matrices
+                    for kind, host, t in reversed(stmt_ops):
+                        if kind == "alloc":
+                            assist.on_unallocate(host, t)
+                        else:
+                            assist.on_unpipeline_alloc(host, t)
 
             namespaces.push(namespace)

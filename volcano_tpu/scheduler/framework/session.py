@@ -61,6 +61,7 @@ class Session:
         self.job_enqueueable_fns: Dict[str, Callable] = {}
 
         self._tier_fns_cache: Dict[tuple, List[List[Callable]]] = {}
+        self._node_order_pairs_cache = None
 
     # ------------------------------------------------------------------
     # registration (session_plugins.go:26-104)
@@ -278,19 +279,32 @@ class Session:
         return scores
 
     def node_order_map_fn(self, task: TaskInfo, node: NodeInfo):
-        """Returns ({plugin: score}, summed order score) (session_plugins.go:474)."""
+        """Returns ({plugin: score}, summed order score) (session_plugins.go:474).
+
+        The (plugin, order fn, map fn) triples are resolved once per
+        registry size — this dispatch runs per (task, node) in the serial
+        prioritize sweep, and re-walking the tier/flag structure per node
+        dominates the actual scoring lambdas."""
+        key = (len(self.node_order_fns), len(self.node_map_fns))
+        cached = self._node_order_pairs_cache
+        if cached is None or cached[0] != key:
+            pairs = []
+            for tier in self.tiers:
+                for plugin in tier.plugins:
+                    if not conf.enabled(plugin.enabled_node_order):
+                        continue
+                    fn = self.node_order_fns.get(plugin.name)
+                    mfn = self.node_map_fns.get(plugin.name)
+                    if fn is not None or mfn is not None:
+                        pairs.append((plugin.name, fn, mfn))
+            cached = self._node_order_pairs_cache = (key, pairs)
         node_score_map: Dict[str, float] = {}
         priority_score = 0.0
-        for tier in self.tiers:
-            for plugin in tier.plugins:
-                if not conf.enabled(plugin.enabled_node_order):
-                    continue
-                fn = self.node_order_fns.get(plugin.name)
-                if fn is not None:
-                    priority_score += fn(task, node)
-                mfn = self.node_map_fns.get(plugin.name)
-                if mfn is not None:
-                    node_score_map[plugin.name] = mfn(task, node)
+        for name, fn, mfn in cached[1]:
+            if fn is not None:
+                priority_score += fn(task, node)
+            if mfn is not None:
+                node_score_map[name] = mfn(task, node)
         return node_score_map, priority_score
 
     def node_order_reduce_fn(self, task: TaskInfo, plugin_node_scores: Dict[str, Dict[str, float]]) -> Dict[str, float]:

@@ -17,7 +17,7 @@
 #include <Python.h>
 
 static PyObject *s_node_name, *s_status, *s_uid, *s_namespace, *s_name,
-    *s_tasks, *s_pod;
+    *s_tasks, *s_pod, *s_status_version, *s_task_status_index, *s_allocated;
 
 /* apply_job_tasks(tis, task_infos, assign, node_names, binding,
  *                 s_pending, s_binding, c_tasks, c_pending, c_binding,
@@ -183,9 +183,568 @@ apply_job_tasks(PyObject *self, PyObject *args)
     Py_RETURN_NONE;
 }
 
+/* ------------------------------------------------------------------ */
+/* whole-session batched writeback                                     */
+/* ------------------------------------------------------------------ */
+
+/* res.milli_cpu += sign*vec[0]; res.memory += sign*vec[1];
+ * res.add_scalar(name, sign*vec[2+si]) for nonzero scalar deltas.
+ * Mirrors ops/solver.py::_apply_bulk.apply_delta exactly. */
+static int
+res_add_vec(PyObject *res, const double *vec, Py_ssize_t R,
+            PyObject *scalar_names, double sign)
+{
+    static PyObject *s_milli_cpu, *s_memory, *s_add_scalar;
+    if (s_milli_cpu == NULL) {
+        s_milli_cpu = PyUnicode_InternFromString("milli_cpu");
+        s_memory = PyUnicode_InternFromString("memory");
+        s_add_scalar = PyUnicode_InternFromString("add_scalar");
+        if (!s_milli_cpu || !s_memory || !s_add_scalar)
+            return -1;
+    }
+    PyObject *names[2] = {s_milli_cpu, s_memory};
+    for (int d = 0; d < 2; d++) {
+        PyObject *v = PyObject_GetAttr(res, names[d]);
+        if (v == NULL)
+            return -1;
+        double cur = PyFloat_AsDouble(v);
+        Py_DECREF(v);
+        if (cur == -1.0 && PyErr_Occurred())
+            return -1;
+        PyObject *nv = PyFloat_FromDouble(cur + sign * vec[d]);
+        if (nv == NULL)
+            return -1;
+        int rc = PyObject_SetAttr(res, names[d], nv);
+        Py_DECREF(nv);
+        if (rc < 0)
+            return -1;
+    }
+    for (Py_ssize_t si = 0; si + 2 < R; si++) {
+        double q = vec[2 + si];
+        if (q == 0.0)
+            continue;
+        PyObject *name = PyTuple_GET_ITEM(scalar_names, si); /* borrowed */
+        PyObject *qv = PyFloat_FromDouble(sign * q);
+        if (qv == NULL)
+            return -1;
+        PyObject *r = PyObject_CallMethodObjArgs(res, s_add_scalar,
+                                                 name, qv, NULL);
+        Py_DECREF(qv);
+        if (r == NULL)
+            return -1;
+        Py_DECREF(r);
+    }
+    return 0;
+}
+
+/* job._status_version += 1 */
+static int
+bump_version(PyObject *job)
+{
+    PyObject *v = PyObject_GetAttr(job, s_status_version);
+    if (v == NULL)
+        return -1;
+    long long x = PyLong_AsLongLong(v);
+    Py_DECREF(v);
+    if (x == -1 && PyErr_Occurred())
+        return -1;
+    PyObject *nv = PyLong_FromLongLong(x + 1);
+    if (nv == NULL)
+        return -1;
+    int rc = PyObject_SetAttr(job, s_status_version, nv);
+    Py_DECREF(nv);
+    return rc;
+}
+
+/* dict.pop(uid, None) where only absence is swallowed */
+static int
+dict_pop_ignore_missing(PyObject *d, PyObject *k)
+{
+    if (PyDict_DelItem(d, k) < 0) {
+        if (!PyErr_ExceptionMatches(PyExc_KeyError))
+            return -1;
+        PyErr_Clear();
+    }
+    return 0;
+}
+
+/* contiguous int64 / float64 buffer views */
+static int
+get_i64(PyObject *obj, Py_buffer *buf, const char *what)
+{
+    if (PyObject_GetBuffer(obj, buf, PyBUF_CONTIG_RO) < 0)
+        return -1;
+    if (buf->itemsize != 8) {
+        PyBuffer_Release(buf);
+        PyErr_Format(PyExc_TypeError, "%s: expected int64 buffer", what);
+        return -1;
+    }
+    return 0;
+}
+
+/* apply_all_jobs(job_nz, seg_ends, placed, assign, task_infos, node_names,
+ *                ssn_nodes, cache_nodes, job_infos, cache_jobs,
+ *                pending, binding, job_sums, scalar_names,
+ *                bind_tasks, bind_pods, bind_hosts, bind_keys)
+ *
+ * Whole-session equivalent of the per-job Python wrapper around
+ * apply_job_tasks in ops/solver.py::_apply_bulk: per-job status-index
+ * surgery (wholesale PENDING->BINDING bucket move when the entire bucket
+ * placed), cache-job mirror updates, per-task attribute/bucket/node-map
+ * writes, allocated-resource deltas — one call for the whole assignment.
+ *
+ * job_nz/seg_ends: int64 buffers (jobs with placements / prefix ends into
+ * placed). placed: int64 task indices, job-major contiguous. assign: int64
+ * node id per task index. job_sums: float64 [J, R] per-job placed
+ * resource sums. cache_jobs: uid -> cache JobInfo dict (or None).
+ * bind_keys receives the "ns/name" key per placement (reused by the
+ * binder/event batch paths so they need no 50k re-derivations). */
+static PyObject *
+apply_all_jobs(PyObject *self, PyObject *args)
+{
+    PyObject *job_nz_o, *seg_ends_o, *placed_o, *assign_o;
+    PyObject *task_infos, *node_names, *ssn_nodes, *cache_nodes;
+    PyObject *job_infos, *cache_jobs, *pending, *binding;
+    PyObject *job_sums_o, *scalar_names;
+    PyObject *bind_tasks, *bind_pods, *bind_hosts, *bind_keys;
+
+    if (!PyArg_ParseTuple(args, "OOOOOOOOOOOOOOOOOO",
+                          &job_nz_o, &seg_ends_o, &placed_o, &assign_o,
+                          &task_infos, &node_names, &ssn_nodes, &cache_nodes,
+                          &job_infos, &cache_jobs, &pending, &binding,
+                          &job_sums_o, &scalar_names,
+                          &bind_tasks, &bind_pods, &bind_hosts, &bind_keys))
+        return NULL;
+
+    int have_cache_nodes = cache_nodes != Py_None;
+    int have_cache_jobs = cache_jobs != Py_None;
+
+    Py_buffer job_nz_b = {0}, seg_ends_b = {0}, placed_b = {0},
+              assign_b = {0}, sums_b = {0};
+    PyObject **ntasks = NULL, **ctasks_n = NULL;
+    char *cresolved = NULL;
+    PyObject *ret = NULL;
+
+    if (get_i64(job_nz_o, &job_nz_b, "job_nz") < 0)
+        return NULL;
+    if (get_i64(seg_ends_o, &seg_ends_b, "seg_ends") < 0)
+        goto done;
+    if (get_i64(placed_o, &placed_b, "placed") < 0)
+        goto done;
+    if (get_i64(assign_o, &assign_b, "assign") < 0)
+        goto done;
+    if (PyObject_GetBuffer(job_sums_o, &sums_b, PyBUF_CONTIG_RO) < 0)
+        goto done;
+    if (sums_b.itemsize != 8) {
+        PyErr_SetString(PyExc_TypeError, "job_sums: expected float64 buffer");
+        goto done;
+    }
+
+    const int64_t *job_nz = (const int64_t *)job_nz_b.buf;
+    const int64_t *seg_ends = (const int64_t *)seg_ends_b.buf;
+    const int64_t *placed = (const int64_t *)placed_b.buf;
+    const int64_t *assign = (const int64_t *)assign_b.buf;
+    const double *sums = (const double *)sums_b.buf;
+    Py_ssize_t n_jobs_nz = job_nz_b.len / 8;
+    Py_ssize_t R = sums_b.len ? (sums_b.ndim == 2 ? sums_b.shape[1]
+                                                  : sums_b.len / 8) : 0;
+    Py_ssize_t n_nodes = PyList_GET_SIZE(node_names);
+
+    /* lazily-resolved per-node task dicts (strong refs) */
+    ntasks = PyMem_Calloc(n_nodes ? n_nodes : 1, sizeof(PyObject *));
+    ctasks_n = PyMem_Calloc(n_nodes ? n_nodes : 1, sizeof(PyObject *));
+    cresolved = PyMem_Calloc(n_nodes ? n_nodes : 1, 1);
+    if (!ntasks || !ctasks_n || !cresolved) {
+        PyErr_NoMemory();
+        goto done;
+    }
+
+    int64_t lo = 0;
+    for (Py_ssize_t jj = 0; jj < n_jobs_nz; jj++) {
+        int64_t ji = job_nz[jj];
+        int64_t hi = seg_ends[jj];
+        Py_ssize_t seg_len = (Py_ssize_t)(hi - lo);
+        PyObject *job = PyList_GET_ITEM(job_infos, ji);      /* borrowed */
+
+        if (bump_version(job) < 0)
+            goto done;
+        PyObject *idx = PyObject_GetAttr(job, s_task_status_index); /* new */
+        if (idx == NULL)
+            goto done;
+        PyObject *s_pend = PyDict_GetItemWithError(idx, pending); /* borrowed */
+        if (s_pend == NULL && PyErr_Occurred()) {
+            Py_DECREF(idx);
+            goto done;
+        }
+        PyObject *s_bind;                                    /* borrowed */
+        int s_pend_active = 0;
+        if (s_pend != NULL && PyDict_GET_SIZE(s_pend) == seg_len) {
+            /* wholesale bucket move: every PENDING task placed */
+            s_bind = PyDict_GetItemWithError(idx, binding);
+            if (s_bind == NULL) {
+                if (PyErr_Occurred()) {
+                    Py_DECREF(idx);
+                    goto done;
+                }
+                if (PyDict_SetItem(idx, binding, s_pend) < 0) {
+                    Py_DECREF(idx);
+                    goto done;
+                }
+                s_bind = s_pend;
+            } else if (PyDict_Merge(s_bind, s_pend, 1) < 0) {
+                Py_DECREF(idx);
+                goto done;
+            }
+            if (PyDict_DelItem(idx, pending) < 0) {
+                Py_DECREF(idx);
+                goto done;
+            }
+        } else {
+            s_pend_active = s_pend != NULL;
+            s_bind = PyDict_GetItemWithError(idx, binding);
+            if (s_bind == NULL) {
+                if (PyErr_Occurred()) {
+                    Py_DECREF(idx);
+                    goto done;
+                }
+                PyObject *fresh = PyDict_New();
+                if (fresh == NULL ||
+                    PyDict_SetItem(idx, binding, fresh) < 0) {
+                    Py_XDECREF(fresh);
+                    Py_DECREF(idx);
+                    goto done;
+                }
+                s_bind = fresh;
+                Py_DECREF(fresh); /* idx holds it */
+            }
+        }
+        Py_DECREF(idx);
+
+        /* cache-job mirror */
+        PyObject *cache_job = NULL;                          /* borrowed */
+        PyObject *c_tasks = NULL;                            /* new */
+        PyObject *c_pend = NULL, *c_bind = NULL;             /* borrowed */
+        int c_pend_active = 0;
+        if (have_cache_jobs) {
+            PyObject *juid = PyObject_GetAttr(job, s_uid);   /* new */
+            if (juid == NULL)
+                goto done;
+            cache_job = PyDict_GetItemWithError(cache_jobs, juid);
+            Py_DECREF(juid);
+            if (cache_job == NULL && PyErr_Occurred())
+                goto done;
+        }
+        if (cache_job != NULL) {
+            if (bump_version(cache_job) < 0)
+                goto done;
+            c_tasks = PyObject_GetAttr(cache_job, s_tasks);
+            if (c_tasks == NULL)
+                goto done;
+            PyObject *cidx = PyObject_GetAttr(cache_job, s_task_status_index);
+            if (cidx == NULL)
+                goto job_fail;
+            c_pend = PyDict_GetItemWithError(cidx, pending);
+            if (c_pend == NULL && PyErr_Occurred()) {
+                Py_DECREF(cidx);
+                goto job_fail;
+            }
+            if (c_pend != NULL && PyDict_GET_SIZE(c_pend) == seg_len) {
+                c_bind = PyDict_GetItemWithError(cidx, binding);
+                if (c_bind == NULL) {
+                    if (PyErr_Occurred()) {
+                        Py_DECREF(cidx);
+                        goto job_fail;
+                    }
+                    if (PyDict_SetItem(cidx, binding, c_pend) < 0) {
+                        Py_DECREF(cidx);
+                        goto job_fail;
+                    }
+                    c_bind = c_pend;
+                } else if (PyDict_Merge(c_bind, c_pend, 1) < 0) {
+                    Py_DECREF(cidx);
+                    goto job_fail;
+                }
+                if (PyDict_DelItem(cidx, pending) < 0) {
+                    Py_DECREF(cidx);
+                    goto job_fail;
+                }
+            } else {
+                c_pend_active = c_pend != NULL;
+                c_bind = PyDict_GetItemWithError(cidx, binding);
+                if (c_bind == NULL) {
+                    if (PyErr_Occurred()) {
+                        Py_DECREF(cidx);
+                        goto job_fail;
+                    }
+                    PyObject *fresh = PyDict_New();
+                    if (fresh == NULL ||
+                        PyDict_SetItem(cidx, binding, fresh) < 0) {
+                        Py_XDECREF(fresh);
+                        Py_DECREF(cidx);
+                        goto job_fail;
+                    }
+                    c_bind = fresh;
+                    Py_DECREF(fresh);
+                }
+            }
+            Py_DECREF(cidx);
+        }
+
+        /* per-task writeback */
+        for (int64_t k = lo; k < hi; k++) {
+            int64_t ti = placed[k];
+            int64_t ni = assign[ti];
+            PyObject *task = PyList_GET_ITEM(task_infos, ti); /* borrowed */
+            PyObject *host = PyList_GET_ITEM(node_names, ni); /* borrowed */
+
+            if (PyObject_SetAttr(task, s_node_name, host) < 0)
+                goto job_fail;
+            if (PyObject_SetAttr(task, s_status, binding) < 0)
+                goto job_fail;
+
+            PyObject *uid = PyObject_GetAttr(task, s_uid);   /* new */
+            if (uid == NULL)
+                goto job_fail;
+            if (s_pend_active) {
+                if (dict_pop_ignore_missing(s_pend, uid) < 0 ||
+                    PyDict_SetItem(s_bind, uid, task) < 0) {
+                    Py_DECREF(uid);
+                    goto job_fail;
+                }
+            }
+
+            PyObject *ns = PyObject_GetAttr(task, s_namespace);
+            PyObject *nm = ns ? PyObject_GetAttr(task, s_name) : NULL;
+            PyObject *key = nm ? PyUnicode_FromFormat("%U/%U", ns, nm) : NULL;
+            Py_XDECREF(ns);
+            Py_XDECREF(nm);
+            if (key == NULL) {
+                Py_DECREF(uid);
+                goto job_fail;
+            }
+
+            /* session node task-map (lazy dict resolve per node) */
+            if (ntasks[ni] == NULL) {
+                PyObject *node = PyDict_GetItemWithError(ssn_nodes, host);
+                if (node == NULL) {
+                    if (!PyErr_Occurred())
+                        PyErr_SetObject(PyExc_KeyError, host);
+                    goto task_fail;
+                }
+                ntasks[ni] = PyObject_GetAttr(node, s_tasks); /* strong */
+                if (ntasks[ni] == NULL)
+                    goto task_fail;
+            }
+            if (PyDict_SetItem(ntasks[ni], key, task) < 0)
+                goto task_fail;
+
+            if (c_tasks != NULL) {
+                PyObject *ctask = PyDict_GetItemWithError(c_tasks, uid);
+                if (ctask == NULL && PyErr_Occurred())
+                    goto task_fail;
+                if (ctask != NULL) {
+                    if (PyObject_SetAttr(ctask, s_node_name, host) < 0)
+                        goto task_fail;
+                    if (PyObject_SetAttr(ctask, s_status, binding) < 0)
+                        goto task_fail;
+                    if (c_pend_active) {
+                        if (dict_pop_ignore_missing(c_pend, uid) < 0 ||
+                            PyDict_SetItem(c_bind, uid, ctask) < 0)
+                            goto task_fail;
+                    }
+                    if (have_cache_nodes) {
+                        if (!cresolved[ni]) {
+                            cresolved[ni] = 1;
+                            PyObject *cnode =
+                                PyDict_GetItemWithError(cache_nodes, host);
+                            if (cnode == NULL && PyErr_Occurred())
+                                goto task_fail;
+                            if (cnode != NULL) {
+                                ctasks_n[ni] =
+                                    PyObject_GetAttr(cnode, s_tasks);
+                                if (ctasks_n[ni] == NULL)
+                                    goto task_fail;
+                            }
+                        }
+                        if (ctasks_n[ni] != NULL &&
+                            PyDict_SetItem(ctasks_n[ni], key, task) < 0)
+                            goto task_fail;
+                    }
+                }
+            }
+
+            if (PyList_Append(bind_tasks, task) < 0)
+                goto task_fail;
+            {
+                PyObject *pod = PyObject_GetAttr(task, s_pod);
+                if (pod == NULL)
+                    goto task_fail;
+                int rc = PyList_Append(bind_pods, pod);
+                Py_DECREF(pod);
+                if (rc < 0)
+                    goto task_fail;
+            }
+            if (PyList_Append(bind_hosts, host) < 0 ||
+                PyList_Append(bind_keys, key) < 0)
+                goto task_fail;
+
+            Py_DECREF(uid);
+            Py_DECREF(key);
+            continue;
+        task_fail:
+            Py_DECREF(uid);
+            Py_XDECREF(key);
+            goto job_fail;
+        }
+
+        /* PENDING -> BINDING leaves total_request unchanged; allocated
+         * grows by the job's placed sum (both trees) */
+        {
+            const double *vec = sums + ji * R;
+            PyObject *alloc = PyObject_GetAttr(job, s_allocated);
+            if (alloc == NULL)
+                goto job_fail;
+            int rc = res_add_vec(alloc, vec, R, scalar_names, 1.0);
+            Py_DECREF(alloc);
+            if (rc < 0)
+                goto job_fail;
+            if (cache_job != NULL) {
+                alloc = PyObject_GetAttr(cache_job, s_allocated);
+                if (alloc == NULL)
+                    goto job_fail;
+                rc = res_add_vec(alloc, vec, R, scalar_names, 1.0);
+                Py_DECREF(alloc);
+                if (rc < 0)
+                    goto job_fail;
+            }
+        }
+
+        Py_XDECREF(c_tasks);
+        lo = hi;
+        continue;
+    job_fail:
+        Py_XDECREF(c_tasks);
+        goto done;
+    }
+
+    ret = Py_None;
+    Py_INCREF(ret);
+done:
+    if (ntasks) {
+        for (Py_ssize_t i = 0; i < n_nodes; i++)
+            Py_XDECREF(ntasks[i]);
+        PyMem_Free(ntasks);
+    }
+    if (ctasks_n) {
+        for (Py_ssize_t i = 0; i < n_nodes; i++)
+            Py_XDECREF(ctasks_n[i]);
+        PyMem_Free(ctasks_n);
+    }
+    PyMem_Free(cresolved);
+    if (job_nz_b.obj)
+        PyBuffer_Release(&job_nz_b);
+    if (seg_ends_b.obj)
+        PyBuffer_Release(&seg_ends_b);
+    if (placed_b.obj)
+        PyBuffer_Release(&placed_b);
+    if (assign_b.obj)
+        PyBuffer_Release(&assign_b);
+    if (sums_b.obj)
+        PyBuffer_Release(&sums_b);
+    return ret;
+}
+
+/* apply_node_deltas(nz, sums, node_names, ssn_nodes, cache_nodes,
+ *                   scalar_names)
+ *
+ * Bulk node accounting: for each touched node index in nz (int64 buffer),
+ * idle -= vec and used += vec on the session NodeInfo and the cache
+ * mirror (when present). sums: float64 [N, R]. Same semantics as the
+ * Python loop in _apply_bulk's post section. */
+static PyObject *
+apply_node_deltas(PyObject *self, PyObject *args)
+{
+    PyObject *nz_o, *sums_o, *node_names, *ssn_nodes, *cache_nodes;
+    PyObject *scalar_names;
+    if (!PyArg_ParseTuple(args, "OOOOOO", &nz_o, &sums_o, &node_names,
+                          &ssn_nodes, &cache_nodes, &scalar_names))
+        return NULL;
+
+    static PyObject *s_idle, *s_used;
+    if (s_idle == NULL) {
+        s_idle = PyUnicode_InternFromString("idle");
+        s_used = PyUnicode_InternFromString("used");
+        if (!s_idle || !s_used)
+            return NULL;
+    }
+
+    Py_buffer nz_b = {0}, sums_b = {0};
+    PyObject *ret = NULL;
+    if (get_i64(nz_o, &nz_b, "nz") < 0)
+        return NULL;
+    if (PyObject_GetBuffer(sums_o, &sums_b, PyBUF_CONTIG_RO) < 0)
+        goto done;
+    if (sums_b.itemsize != 8) {
+        PyErr_SetString(PyExc_TypeError, "sums: expected float64 buffer");
+        goto done;
+    }
+    const int64_t *nz = (const int64_t *)nz_b.buf;
+    const double *sums = (const double *)sums_b.buf;
+    Py_ssize_t count = nz_b.len / 8;
+    Py_ssize_t R = sums_b.ndim == 2 ? sums_b.shape[1] : 0;
+    if (R == 0) {
+        PyErr_SetString(PyExc_TypeError, "sums: expected [N, R] array");
+        goto done;
+    }
+    int have_cache = cache_nodes != Py_None;
+
+    for (Py_ssize_t i = 0; i < count; i++) {
+        int64_t ni = nz[i];
+        const double *vec = sums + ni * R;
+        PyObject *name = PyList_GET_ITEM(node_names, ni);    /* borrowed */
+        for (int tree = 0; tree < 2; tree++) {
+            PyObject *src = tree == 0 ? ssn_nodes : cache_nodes;
+            if (tree == 1 && !have_cache)
+                break;
+            PyObject *node = PyDict_GetItemWithError(src, name);
+            if (node == NULL) {
+                if (PyErr_Occurred())
+                    goto done;
+                continue;
+            }
+            PyObject *idle = PyObject_GetAttr(node, s_idle);
+            if (idle == NULL)
+                goto done;
+            int rc = res_add_vec(idle, vec, R, scalar_names, -1.0);
+            Py_DECREF(idle);
+            if (rc < 0)
+                goto done;
+            PyObject *used = PyObject_GetAttr(node, s_used);
+            if (used == NULL)
+                goto done;
+            rc = res_add_vec(used, vec, R, scalar_names, 1.0);
+            Py_DECREF(used);
+            if (rc < 0)
+                goto done;
+        }
+    }
+    ret = Py_None;
+    Py_INCREF(ret);
+done:
+    if (nz_b.obj)
+        PyBuffer_Release(&nz_b);
+    if (sums_b.obj)
+        PyBuffer_Release(&sums_b);
+    return ret;
+}
+
 static PyMethodDef methods[] = {
     {"apply_job_tasks", apply_job_tasks, METH_VARARGS,
      "Native per-task placement writeback for one job segment."},
+    {"apply_all_jobs", apply_all_jobs, METH_VARARGS,
+     "Whole-session batched placement writeback (all jobs, one call)."},
+    {"apply_node_deltas", apply_node_deltas, METH_VARARGS,
+     "Bulk idle/used node accounting for touched nodes."},
     {NULL, NULL, 0, NULL},
 };
 
@@ -205,8 +764,12 @@ PyInit__fastapply(void)
     s_name = PyUnicode_InternFromString("name");
     s_tasks = PyUnicode_InternFromString("tasks");
     s_pod = PyUnicode_InternFromString("pod");
+    s_status_version = PyUnicode_InternFromString("_status_version");
+    s_task_status_index = PyUnicode_InternFromString("task_status_index");
+    s_allocated = PyUnicode_InternFromString("allocated");
     if (!s_node_name || !s_status || !s_uid || !s_namespace || !s_name ||
-        !s_tasks || !s_pod)
+        !s_tasks || !s_pod || !s_status_version || !s_task_status_index ||
+        !s_allocated)
         return NULL;
     return PyModule_Create(&moduledef);
 }

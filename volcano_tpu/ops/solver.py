@@ -480,14 +480,12 @@ class BatchAllocator:
         alloc_vols = vb.allocate_volumes
         bind_vols = vb.bind_volumes
 
-        assign_l = assign.tolist()
-        placed_l = np.nonzero(placed_mask)[0].tolist()
-        job_nz = np.nonzero(job_placed_n)[0]
-        seg_ends = np.cumsum(job_placed_n[job_nz]).tolist()
-        job_nz = job_nz.tolist()
-        job_sums_l = job_sums.tolist()
+        placed_arr = np.nonzero(placed_mask)[0]
+        job_nz_arr = np.nonzero(job_placed_n)[0]
+        seg_ends_arr = np.cumsum(job_placed_n[job_nz_arr])
+        job_nz = job_nz_arr.tolist()
 
-        # tasks are contiguous per job on the flat axis, so placed_l visits
+        # tasks are contiguous per job on the flat axis, so placed visits
         # each job's placements as one contiguous run. The loop allocates
         # ~1 object + a few dict entries per task; suppress the cyclic GC so
         # gen-promotion scans of the (multi-million-object) session heap
@@ -501,21 +499,35 @@ class BatchAllocator:
         bind_tasks: list = []
         bind_pods: list = []
         bind_hosts: list = []
-        # native inner loop (volcano_tpu/_native/fastapply.c): identical
+        bind_keys: list = []
+        # native batched loop (volcano_tpu/_native/fastapply.c): identical
         # semantics to the Python body below, which remains the fallback
         # and oracle; volumes force the Python path (effector calls)
-        fast = None
-        if vols_noop:
-            # non-blocking: a cold process compiles on a background thread
-            # and THIS session runs the Python loop; never wait on cc here
-            from volcano_tpu._native import get_fastapply_nowait
+        # non-blocking: a cold process compiles on a background thread
+        # and THIS session runs the Python loop; never wait on cc here
+        from volcano_tpu._native import get_fastapply_nowait
 
-            mod = get_fastapply_nowait()
-            if mod is not None:
-                fast = mod.apply_job_tasks
+        mod = get_fastapply_nowait()
+        fast_all = getattr(mod, "apply_all_jobs", None) \
+            if (mod is not None and vols_noop) else None
         try:
+            if fast_all is not None:
+                fast_all(
+                    job_nz_arr, seg_ends_arr, placed_arr,
+                    assign.astype(np.int64),
+                    task_infos, node_names, ssn_nodes, cache_nodes,
+                    job_infos, cache.jobs, PENDING, BINDING,
+                    np.ascontiguousarray(job_sums),
+                    tuple(scalar_names),
+                    bind_tasks, bind_pods, bind_hosts, bind_keys)
+                loop_jobs = ()  # the batched call covered every job
+            else:
+                loop_jobs = job_nz
+                assign_l = assign.tolist()
+                placed_l = placed_arr.tolist()
+                job_sums_l = job_sums.tolist()
             lo = 0
-            for ji, hi in zip(job_nz, seg_ends):
+            for ji, hi in zip(loop_jobs, seg_ends_arr.tolist()):
                 tis = placed_l[lo:hi]
                 lo = hi
                 job = job_infos[ji]
@@ -560,50 +572,45 @@ class BatchAllocator:
                 else:
                     c_tasks = c_pending = c_binding = None
 
-                if fast is not None:
-                    fast(tis, task_infos, assign_l, node_names, BINDING,
-                         s_pending, s_binding, c_tasks, c_pending, c_binding,
-                         ssn_nodes, cache_nodes, bind_tasks, bind_pods,
-                         bind_hosts)
-                else:
-                    for ti in tis:
-                        task = task_infos[ti]
-                        host = node_names[assign_l[ti]]
-                        task.node_name = host
-                        task.status = BINDING
-                        uid = task.uid
-                        if s_pending is not None:
-                            s_pending.pop(uid, None)
-                            s_binding[uid] = task
-                        # the session task itself is shared into both node
-                        # task-maps (the serial path stores clones so LATER
-                        # status flips can't corrupt node accounting;
-                        # nothing flips a BINDING task in place for the
-                        # rest of this session, and cache watch events
-                        # REPLACE node entries rather than mutate them, so
-                        # the share is safe and saves one object per
-                        # placement)
-                        key = task.namespace + "/" + task.name
-                        ssn_nodes[host].tasks[key] = task
-                        if c_tasks is not None:
-                            ctask = c_tasks.get(uid)
-                            if ctask is not None:
-                                ctask.node_name = host
-                                ctask.status = BINDING
-                                if c_pending is not None:
-                                    c_pending.pop(uid, None)
-                                    c_binding[uid] = ctask
-                                cnode = cache_nodes.get(host)
-                                if cnode is not None:
-                                    cnode.tasks[key] = task
-                        # effector contract matches session.dispatch ->
-                        # cache.bind (cache.py:374-395): volumes, binder
-                        if not vols_noop:
-                            alloc_vols(task, host)
-                            bind_vols(task)
-                        bind_tasks.append(task)
-                        bind_pods.append(task.pod)
-                        bind_hosts.append(host)
+                for ti in tis:
+                    task = task_infos[ti]
+                    host = node_names[assign_l[ti]]
+                    task.node_name = host
+                    task.status = BINDING
+                    uid = task.uid
+                    if s_pending is not None:
+                        s_pending.pop(uid, None)
+                        s_binding[uid] = task
+                    # the session task itself is shared into both node
+                    # task-maps (the serial path stores clones so LATER
+                    # status flips can't corrupt node accounting;
+                    # nothing flips a BINDING task in place for the
+                    # rest of this session, and cache watch events
+                    # REPLACE node entries rather than mutate them, so
+                    # the share is safe and saves one object per
+                    # placement)
+                    key = task.namespace + "/" + task.name
+                    ssn_nodes[host].tasks[key] = task
+                    if c_tasks is not None:
+                        ctask = c_tasks.get(uid)
+                        if ctask is not None:
+                            ctask.node_name = host
+                            ctask.status = BINDING
+                            if c_pending is not None:
+                                c_pending.pop(uid, None)
+                                c_binding[uid] = ctask
+                            cnode = cache_nodes.get(host)
+                            if cnode is not None:
+                                cnode.tasks[key] = task
+                    # effector contract matches session.dispatch ->
+                    # cache.bind (cache.py:374-395): volumes, binder
+                    if not vols_noop:
+                        alloc_vols(task, host)
+                        bind_vols(task)
+                    bind_tasks.append(task)
+                    bind_pods.append(task.pod)
+                    bind_hosts.append(host)
+                    bind_keys.append(key)
 
                 # PENDING -> BINDING leaves total_request unchanged;
                 # allocated grows by the job's placed sum
@@ -621,7 +628,17 @@ class BatchAllocator:
         # --- batch binder + events ----------------------------------------
         binder = cache.binder
         retry_from = None
-        if hasattr(binder, "bind_many"):
+        keyed_bind = getattr(binder, "bind_many_keyed", None)
+        if keyed_bind is not None:
+            # the apply loop already derived each placement's ns/name key;
+            # a keyed binder skips 50k metadata re-derivations
+            try:
+                keyed_bind(bind_keys, bind_pods, bind_hosts)
+            except BindManyError as e:
+                retry_from = e.done
+            except Exception:
+                retry_from = 0
+        elif hasattr(binder, "bind_many"):
             try:
                 # pods were extracted during the apply loop; zip streams the
                 # pairs without materializing another 50k-tuple list
@@ -644,11 +661,18 @@ class BatchAllocator:
                 except Exception:
                     cache.resync_task(task)
         if cache.store is not None:
-            cache.store.record_events(
-                (task.pod, "Normal", "Scheduled",
-                 f"Successfully assigned "
-                 f"{task.namespace}/{task.name} to {host}")
-                for task, host in zip(bind_tasks, bind_hosts))
+            record_scheduled = getattr(cache.store, "record_scheduled", None)
+            if record_scheduled is not None:
+                # lazy batch record: the Scheduled message materializes on
+                # read, not on the session's critical path (the reference
+                # recorder is an async broadcaster — cache.go:601-611)
+                record_scheduled(bind_keys, bind_hosts)
+            else:
+                cache.store.record_events(
+                    (task.pod, "Normal", "Scheduled",
+                     f"Successfully assigned "
+                     f"{task.namespace}/{task.name} to {host}")
+                    for task, host in zip(bind_tasks, bind_hosts))
 
         if enc.spec.use_exclusion:
             # device-placed exclusion-group pods carry required
@@ -668,15 +692,22 @@ class BatchAllocator:
         prof_t3 = time.perf_counter()
 
         # --- bulk node accounting (session + cache trees) -----------------
-        sums_l = sums.tolist()
-        for ni in np.nonzero(counts)[0].tolist():
-            vec = sums_l[ni]
-            name = node_names[ni]
-            for node in (ssn_nodes.get(name), cache_nodes.get(name)):
-                if node is None:
-                    continue
-                apply_delta(node.idle, vec, -1.0)
-                apply_delta(node.used, vec, +1.0)
+        fast_nodes = getattr(mod, "apply_node_deltas", None) \
+            if mod is not None else None
+        if fast_nodes is not None:
+            fast_nodes(np.nonzero(counts)[0], np.ascontiguousarray(sums),
+                       node_names, ssn_nodes, cache_nodes,
+                       tuple(scalar_names))
+        else:
+            sums_l = sums.tolist()
+            for ni in np.nonzero(counts)[0].tolist():
+                vec = sums_l[ni]
+                name = node_names[ni]
+                for node in (ssn_nodes.get(name), cache_nodes.get(name)):
+                    if node is None:
+                        continue
+                    apply_delta(node.idle, vec, -1.0)
+                    apply_delta(node.used, vec, +1.0)
 
         # --- bulk plugin share updates (drf / proportion) -----------------
         # per-job DRF shares must be exact per job; namespace/queue shares
@@ -685,11 +716,13 @@ class BatchAllocator:
         drf = ssn.plugins.get("drf")
         prop = ssn.plugins.get("proportion")
         if drf is not None:
+            job_sums_rows = job_sums_l if fast_all is None else \
+                job_sums.tolist()
             for ji in job_nz:
                 job = job_infos[ji]
                 attr = drf.job_attrs.get(job.uid)
                 if attr is not None:
-                    apply_delta(attr.allocated, job_sums_l[ji], +1.0)
+                    apply_delta(attr.allocated, job_sums_rows[ji], +1.0)
                     drf._update_share(attr)
         if (drf is not None and drf.namespace_opts) or prop is not None:
             ns_count_enc = int(a["ns_active0"].shape[0])

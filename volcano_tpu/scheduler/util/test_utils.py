@@ -135,6 +135,14 @@ class FakeBinder:
             self.channel.extend(k for k, _ in keyed)
             self._cond.notify_all()
 
+    def bind_many_keyed(self, keys, pods, hosts) -> None:
+        """Batch bind with caller-derived ns/name keys (the bulk-apply
+        writeback already built them); skips 50k metadata re-derivations."""
+        with self._cond:
+            self.binds.update(zip(keys, hosts))
+            self.channel.extend(keys)
+            self._cond.notify_all()
+
     def wait_for_binds(self, n: int, timeout: float = 5.0) -> bool:
         with self._cond:
             return self._cond.wait_for(lambda: len(self.binds) >= n, timeout)

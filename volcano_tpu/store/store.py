@@ -25,6 +25,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
+from itertools import repeat
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from volcano_tpu.api import objects
@@ -72,6 +73,30 @@ class RecordedEvent:
     reason: str
     message: str
     timestamp: float = field(default_factory=time.time)
+
+
+class ScheduledEvent:
+    """A Pod Scheduled event whose message materializes on read.
+
+    The bulk-apply writeback records one event per placement; at 50k
+    placements/session, formatting 50k messages eagerly would sit on the
+    session's critical path for work nobody may ever read — the reference
+    recorder is an async broadcaster with the same effect (the event text
+    exists only when an observer consumes it)."""
+
+    __slots__ = ("object_key", "host", "timestamp")
+    object_kind = "Pod"
+    event_type = "Normal"
+    reason = "Scheduled"
+
+    def __init__(self, key: str, host: str, ts: float):
+        self.object_key = key
+        self.host = host
+        self.timestamp = ts
+
+    @property
+    def message(self) -> str:
+        return f"Successfully assigned {self.object_key} to {self.host}"
 
 
 class Store:
@@ -257,6 +282,14 @@ class Store:
                 )
                 for obj, event_type, reason, message in items
             )
+
+    def record_scheduled(self, keys, hosts) -> None:
+        """Bulk Pod-Scheduled events from pre-derived ns/name keys; the
+        message is lazy (ScheduledEvent), so the cost per placement is one
+        small object, not a string format."""
+        ts = time.time()
+        with self._lock:
+            self.events.extend(map(ScheduledEvent, keys, hosts, repeat(ts)))
 
     def events_for(self, obj) -> List[RecordedEvent]:
         key = object_key(obj)

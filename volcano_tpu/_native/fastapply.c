@@ -17,7 +17,8 @@
 #include <Python.h>
 
 static PyObject *s_node_name, *s_status, *s_uid, *s_namespace, *s_name,
-    *s_tasks, *s_pod, *s_status_version, *s_task_status_index, *s_allocated;
+    *s_tasks, *s_pod, *s_status_version, *s_task_status_index, *s_allocated,
+    *s_key;
 
 /* apply_job_tasks(tis, task_infos, assign, node_names, binding,
  *                 s_pending, s_binding, c_tasks, c_pending, c_binding,
@@ -513,11 +514,7 @@ apply_all_jobs(PyObject *self, PyObject *args)
                 }
             }
 
-            PyObject *ns = PyObject_GetAttr(task, s_namespace);
-            PyObject *nm = ns ? PyObject_GetAttr(task, s_name) : NULL;
-            PyObject *key = nm ? PyUnicode_FromFormat("%U/%U", ns, nm) : NULL;
-            Py_XDECREF(ns);
-            Py_XDECREF(nm);
+            PyObject *key = PyObject_GetAttr(task, s_key); /* precomputed */
             if (key == NULL) {
                 Py_DECREF(uid);
                 goto job_fail;
@@ -738,6 +735,140 @@ done:
     return ret;
 }
 
+/* update_drf_shares(job_nz, sums, attrs, total_names, total_vals,
+ *                   scalar_names)
+ *
+ * Per placed job: attr.allocated += sums[ji]; then recompute the DRF
+ * dominant share exactly like drf._update_share / share_helpers.share
+ * (r == 0 -> 0 if l == 0 else 1; strictly-greater keeps the FIRST
+ * dominant dimension on ties). attrs is aligned with job_nz and may hold
+ * None for jobs without a DRF attr. total_names[0:2] must be
+ * ("cpu", "memory"); later entries are scalar resource names looked up in
+ * allocated.scalar_resources. */
+static PyObject *
+update_drf_shares(PyObject *self, PyObject *args)
+{
+    PyObject *job_nz_o, *sums_o, *attrs, *total_names, *total_vals_o;
+    PyObject *scalar_names;
+    if (!PyArg_ParseTuple(args, "OOOOOO", &job_nz_o, &sums_o, &attrs,
+                          &total_names, &total_vals_o, &scalar_names))
+        return NULL;
+
+    static PyObject *s_alloc_attr, *s_share, *s_dominant, *s_milli_cpu2,
+        *s_memory2, *s_scalar_resources, *s_empty;
+    if (s_alloc_attr == NULL) {
+        s_alloc_attr = PyUnicode_InternFromString("allocated");
+        s_share = PyUnicode_InternFromString("share");
+        s_dominant = PyUnicode_InternFromString("dominant_resource");
+        s_milli_cpu2 = PyUnicode_InternFromString("milli_cpu");
+        s_memory2 = PyUnicode_InternFromString("memory");
+        s_scalar_resources = PyUnicode_InternFromString("scalar_resources");
+        s_empty = PyUnicode_InternFromString("");
+        if (!s_alloc_attr || !s_share || !s_dominant || !s_milli_cpu2 ||
+            !s_memory2 || !s_scalar_resources || !s_empty)
+            return NULL;
+    }
+
+    Py_buffer nz_b = {0}, sums_b = {0}, tv_b = {0};
+    PyObject *ret = NULL;
+    if (get_i64(job_nz_o, &nz_b, "job_nz") < 0)
+        return NULL;
+    if (PyObject_GetBuffer(sums_o, &sums_b, PyBUF_CONTIG_RO) < 0)
+        goto done;
+    if (PyObject_GetBuffer(total_vals_o, &tv_b, PyBUF_CONTIG_RO) < 0)
+        goto done;
+    if (sums_b.itemsize != 8 || tv_b.itemsize != 8) {
+        PyErr_SetString(PyExc_TypeError, "expected float64 buffers");
+        goto done;
+    }
+    const int64_t *nz = (const int64_t *)nz_b.buf;
+    const double *sums = (const double *)sums_b.buf;
+    const double *tvals = (const double *)tv_b.buf;
+    Py_ssize_t count = nz_b.len / 8;
+    Py_ssize_t R = sums_b.ndim == 2 ? sums_b.shape[1] : 0;
+    Py_ssize_t D = PyTuple_GET_SIZE(total_names);
+    if (R == 0) {
+        PyErr_SetString(PyExc_TypeError, "sums: expected [J, R] array");
+        goto done;
+    }
+
+    for (Py_ssize_t i = 0; i < count; i++) {
+        PyObject *attr = PyList_GET_ITEM(attrs, i);          /* borrowed */
+        if (attr == Py_None)
+            continue;
+        int64_t ji = nz[i];
+        const double *vec = sums + ji * R;
+        PyObject *alloc = PyObject_GetAttr(attr, s_alloc_attr); /* new */
+        if (alloc == NULL)
+            goto done;
+        if (res_add_vec(alloc, vec, R, scalar_names, 1.0) < 0) {
+            Py_DECREF(alloc);
+            goto done;
+        }
+        /* dominant share over the cluster total's dimensions */
+        double best = 0.0;
+        PyObject *dom = s_empty;                             /* borrowed */
+        PyObject *scalars = NULL;                            /* new */
+        int fail = 0;
+        for (Py_ssize_t d = 0; d < D; d++) {
+            double av;
+            if (d < 2) {
+                PyObject *v = PyObject_GetAttr(
+                    alloc, d == 0 ? s_milli_cpu2 : s_memory2);
+                if (v == NULL) { fail = 1; break; }
+                av = PyFloat_AsDouble(v);
+                Py_DECREF(v);
+                if (av == -1.0 && PyErr_Occurred()) { fail = 1; break; }
+            } else {
+                if (scalars == NULL) {
+                    scalars = PyObject_GetAttr(alloc, s_scalar_resources);
+                    if (scalars == NULL) { fail = 1; break; }
+                }
+                av = 0.0;
+                if (scalars != Py_None) {
+                    PyObject *q = PyDict_GetItemWithError(
+                        scalars, PyTuple_GET_ITEM(total_names, d));
+                    if (q == NULL && PyErr_Occurred()) { fail = 1; break; }
+                    if (q != NULL) {
+                        av = PyFloat_AsDouble(q);
+                        if (av == -1.0 && PyErr_Occurred()) {
+                            fail = 1;
+                            break;
+                        }
+                    }
+                }
+            }
+            double tv = tvals[d];
+            double s = tv == 0.0 ? (av == 0.0 ? 0.0 : 1.0) : av / tv;
+            if (s > best) {
+                best = s;
+                dom = PyTuple_GET_ITEM(total_names, d);
+            }
+        }
+        Py_XDECREF(scalars);
+        Py_DECREF(alloc);
+        if (fail)
+            goto done;
+        PyObject *bv = PyFloat_FromDouble(best);
+        if (bv == NULL)
+            goto done;
+        int rc = PyObject_SetAttr(attr, s_share, bv);
+        Py_DECREF(bv);
+        if (rc < 0 || PyObject_SetAttr(attr, s_dominant, dom) < 0)
+            goto done;
+    }
+    ret = Py_None;
+    Py_INCREF(ret);
+done:
+    if (nz_b.obj)
+        PyBuffer_Release(&nz_b);
+    if (sums_b.obj)
+        PyBuffer_Release(&sums_b);
+    if (tv_b.obj)
+        PyBuffer_Release(&tv_b);
+    return ret;
+}
+
 static PyMethodDef methods[] = {
     {"apply_job_tasks", apply_job_tasks, METH_VARARGS,
      "Native per-task placement writeback for one job segment."},
@@ -745,6 +876,8 @@ static PyMethodDef methods[] = {
      "Whole-session batched placement writeback (all jobs, one call)."},
     {"apply_node_deltas", apply_node_deltas, METH_VARARGS,
      "Bulk idle/used node accounting for touched nodes."},
+    {"update_drf_shares", update_drf_shares, METH_VARARGS,
+     "Batched DRF allocated-delta + dominant-share recompute."},
     {NULL, NULL, 0, NULL},
 };
 
@@ -767,9 +900,10 @@ PyInit__fastapply(void)
     s_status_version = PyUnicode_InternFromString("_status_version");
     s_task_status_index = PyUnicode_InternFromString("task_status_index");
     s_allocated = PyUnicode_InternFromString("allocated");
+    s_key = PyUnicode_InternFromString("key");
     if (!s_node_name || !s_status || !s_uid || !s_namespace || !s_name ||
         !s_tasks || !s_pod || !s_status_version || !s_task_status_index ||
-        !s_allocated)
+        !s_allocated || !s_key)
         return NULL;
     return PyModule_Create(&moduledef);
 }

@@ -44,6 +44,9 @@ class TaskInfo:
         # cache assigns them; clones inherit; (row, row_gen) validate reads
         "row",
         "row_gen",
+        # "namespace/name", precomputed once — the node task-map / binder /
+        # event key that hot paths would otherwise re-format per use
+        "key",
     )
 
     def __init__(
@@ -73,6 +76,7 @@ class TaskInfo:
         self.pod = pod
         self.row = -1
         self.row_gen = -1
+        self.key = namespace + "/" + name
 
     def clone(self) -> "TaskInfo":
         t = TaskInfo(
@@ -112,6 +116,7 @@ class TaskInfo:
         t.pod = self.pod
         t.row = self.row
         t.row_gen = self.row_gen
+        t.key = self.key
         return t
 
     def __repr__(self) -> str:
@@ -163,6 +168,11 @@ class JobInfo:
         self._status_version = 0
         self._ready_cache = None
         self._valid_cache = None
+        # columnar view of the PENDING bucket captured by clone() while it
+        # is already touching every task: (tasks, rows, row_gens, version).
+        # Valid only while _status_version still matches — any index
+        # mutation invalidates it (see pending_axis)
+        self._pending_axis = None
 
         self.allocated = Resource.empty()
         self.total_request = Resource.empty()
@@ -330,9 +340,30 @@ class JobInfo:
         info.pdb = self.pdb
         info.pod_group = self.pod_group
         info.creation_timestamp = self.creation_timestamp
+        # capture the PENDING columnar axis while this walk already holds
+        # each task: the encoder's task axis becomes list-concats + one
+        # fromiter instead of a second 50k-object walk per session
+        pend_t: list = []
+        pend_r: list = []
+        pend_g: list = []
         for task in self.tasks.values():
-            info.add_task_info(task.clone())
+            t = task.clone()
+            info.add_task_info(t)
+            if t.status == TaskStatus.PENDING:
+                pend_t.append(t)
+                pend_r.append(t.row)
+                pend_g.append(t.row_gen)
+        info._pending_axis = (pend_t, pend_r, pend_g, info._status_version)
         return info
+
+    def pending_axis(self):
+        """The clone-captured (tasks, rows, row_gens) of the PENDING
+        bucket, or None when the status index changed since capture (the
+        caller walks the bucket instead)."""
+        ax = self._pending_axis
+        if ax is not None and ax[3] == self._status_version:
+            return ax[0], ax[1], ax[2]
+        return None
 
     def is_terminated(self) -> bool:
         """helpers.go JobTerminated."""

@@ -315,23 +315,43 @@ def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue,
     from volcano_tpu.scheduler.cache.podtable import (
         FLAG_AFFINITY, FLAG_PORTS, FLAG_REQ_EMPTY)
 
+    from itertools import chain
+
     all_tasks: List[TaskInfo] = []
-    job_of: List[int] = []
+    rows_parts: list = []
+    gens_parts: list = []
+    nz_jobs: list = []
+    nz_counts: list = []
     for ji, job in enumerate(jobs):
-        pend = job.task_status_index.get(TaskStatus.PENDING)
-        if not pend:
-            continue
-        for t in pend.values():
-            all_tasks.append(t)
-            job_of.append(ji)
+        # clone-captured columnar pending axis (job_info.py pending_axis):
+        # no per-task walk unless the status index moved since snapshot
+        ax = job.pending_axis() if hasattr(job, "pending_axis") else None
+        if ax is not None:
+            t_l, r_l, g_l = ax
+            if not t_l:
+                continue
+        else:
+            pend = job.task_status_index.get(TaskStatus.PENDING)
+            if not pend:
+                continue
+            t_l = list(pend.values())
+            r_l = [t.row for t in t_l]
+            g_l = [t.row_gen for t in t_l]
+        all_tasks.extend(t_l)
+        rows_parts.append(r_l)
+        gens_parts.append(g_l)
+        nz_jobs.append(ji)
+        nz_counts.append(len(t_l))
     p_count = len(all_tasks)
     if p_count == 0:
         return None  # legacy handles the empty axis trivially
 
-    rows = np.fromiter((t.row for t in all_tasks), np.int64, p_count)
+    rows = np.fromiter(chain.from_iterable(rows_parts), np.int64, p_count)
     if rows.min() < 0:
         return None  # task(s) without table rows (podless) — object walk
-    gens = np.fromiter((t.row_gen for t in all_tasks), np.int64, p_count)
+    gens = np.fromiter(chain.from_iterable(gens_parts), np.int64, p_count)
+    job_of_arr = np.repeat(np.asarray(nz_jobs, np.int64),
+                           np.asarray(nz_counts, np.int64))
 
     scalar_set = set(table.scalar_names())
     for node in nodes:
@@ -350,7 +370,6 @@ def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue,
         else np.arange(p_count)
     if sub.size == 0:
         return None
-    job_of_arr = np.asarray(job_of, np.int64)
     uid = g["uid"]  # table-maintained object column; no per-session build
     prio = g["priority"] if prio_on else np.zeros(p_count, np.int64)
     order = np.lexsort(
@@ -760,6 +779,16 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
         node_ok = np.array(
             [_static_node_ok(n, memory_p, disk_p, pid_p) for n in nodes]
         )
+        # nodes carrying schedulability-affecting taints, computed once: a
+        # selector-free pod only needs per-node work on THOSE nodes, which
+        # drops the common no-selector/no-taint signature from O(N) Python
+        # calls to one mask copy
+        tainted = [
+            ni for ni, n in enumerate(nodes)
+            if n.node is not None and any(
+                t.effect in ("NoSchedule", "NoExecute")
+                for t in n.node.spec.taints)
+        ]
         for si, rep in enumerate(sig_rep):
             pod = rep.pod
             if pod is None:
@@ -767,13 +796,23 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
                 # (predicates.py predicate_fn: pod is None -> pass), so the
                 # static mask must stay all-True for them
                 continue
-            row = np.array(
-                [
-                    predicates_mod.pod_matches_node_selector(pod, n)
-                    and predicates_mod.tolerates_taints(pod, n)
-                    for n in nodes
-                ]
-            )
+            aff = pod.spec.affinity
+            selector_free = (
+                not pod.spec.node_selector
+                and (aff is None or aff.node_affinity is None
+                     or not aff.node_affinity.required_terms))
+            if selector_free:
+                row = np.ones(n_count, bool)
+                for ni in tainted:
+                    row[ni] = predicates_mod.tolerates_taints(pod, nodes[ni])
+            else:
+                row = np.array(
+                    [
+                        predicates_mod.pod_matches_node_selector(pod, n)
+                        and predicates_mod.tolerates_taints(pod, n)
+                        for n in nodes
+                    ]
+                )
             sig_mask[si] = node_ok & row
 
         # required anti-affinity SYMMETRY of existing pods: a new pod that

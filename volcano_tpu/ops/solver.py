@@ -589,7 +589,7 @@ class BatchAllocator:
                     # REPLACE node entries rather than mutate them, so
                     # the share is safe and saves one object per
                     # placement)
-                    key = task.namespace + "/" + task.name
+                    key = task.key
                     ssn_nodes[host].tasks[key] = task
                     if c_tasks is not None:
                         ctask = c_tasks.get(uid)
@@ -716,14 +716,25 @@ class BatchAllocator:
         drf = ssn.plugins.get("drf")
         prop = ssn.plugins.get("proportion")
         if drf is not None:
-            job_sums_rows = job_sums_l if fast_all is None else \
-                job_sums.tolist()
-            for ji in job_nz:
-                job = job_infos[ji]
-                attr = drf.job_attrs.get(job.uid)
-                if attr is not None:
-                    apply_delta(attr.allocated, job_sums_rows[ji], +1.0)
-                    drf._update_share(attr)
+            fast_drf = getattr(mod, "update_drf_shares", None) \
+                if mod is not None else None
+            if fast_drf is not None:
+                attrs = [drf.job_attrs.get(job_infos[ji].uid)
+                         for ji in job_nz]
+                tnames = tuple(drf.total_resource.resource_names())
+                tvals = np.array([drf.total_resource.get(n) for n in tnames])
+                fast_drf(np.asarray(job_nz, np.int64),
+                         np.ascontiguousarray(job_sums),
+                         attrs, tnames, tvals, tuple(scalar_names))
+            else:
+                job_sums_rows = job_sums_l if fast_all is None else \
+                    job_sums.tolist()
+                for ji in job_nz:
+                    job = job_infos[ji]
+                    attr = drf.job_attrs.get(job.uid)
+                    if attr is not None:
+                        apply_delta(attr.allocated, job_sums_rows[ji], +1.0)
+                        drf._update_share(attr)
         if (drf is not None and drf.namespace_opts) or prop is not None:
             ns_count_enc = int(a["ns_active0"].shape[0])
             q_count_enc = int(a["queue_deserved"].shape[0])

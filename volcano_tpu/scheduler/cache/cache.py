@@ -64,11 +64,6 @@ class DefaultBinder:
         except Exception as e:
             raise BindManyError(done, e) from e
 
-    def bind_many_keyed(self, keys, pods, hosts) -> None:
-        """Keyed batch bind (bulk-apply fast path); the store commit needs
-        the pods, so the pre-derived keys are advisory here."""
-        self.bind_many(zip(pods, hosts))
-
 
 class DefaultEvictor:
     """Graceful deletion: stamp deletion_timestamp; the kubelet analog

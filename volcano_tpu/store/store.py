@@ -109,7 +109,8 @@ class Store:
         self._mutators: Dict[str, List[Callable]] = {}
         self._validators: Dict[str, List[Callable]] = {}
         self._resource_version = 0
-        self.events: List[RecordedEvent] = []
+        # RecordedEvent | ScheduledEvent (duck-typed event contract)
+        self.events: list = []
 
     # -- admission ---------------------------------------------------------
 
@@ -291,7 +292,10 @@ class Store:
         with self._lock:
             self.events.extend(map(ScheduledEvent, keys, hosts, repeat(ts)))
 
-    def events_for(self, obj) -> List[RecordedEvent]:
+    def events_for(self, obj) -> list:
+        """Events recorded against ``obj``. Entries are RecordedEvent or
+        ScheduledEvent — both expose object_kind / object_key / event_type /
+        reason / message / timestamp (duck-typed event contract)."""
         key = object_key(obj)
         kind = type(obj).KIND
         with self._lock:

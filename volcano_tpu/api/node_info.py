@@ -105,7 +105,13 @@ class NodeInfo:
             raise RuntimeError(
                 f"task <{task.namespace}/{task.name}> already on node <{self.name}>"
             )
-        ti = task.clone()
+        # status-frozen copy: the map entry must not see later status flips
+        # of the caller's object (node_info.go:188-220 clones for the same
+        # reason), but resreq/init_resreq are never mutated in place
+        # anywhere in the tree, so sharing them skips two Resource
+        # deep-copies per placement — the statement-path analog of the bulk
+        # writeback's shared_clone usage
+        ti = task.shared_clone()
         if self.node is not None:
             if ti.status == TaskStatus.RELEASING:
                 self._allocate_idle(ti)

@@ -22,7 +22,10 @@ from volcano_tpu.api.types import TaskStatus
 from volcano_tpu.api.unschedule_info import NODE_RESOURCE_FIT_FAILED, FitFailure
 from volcano_tpu.scheduler.framework.interface import Action
 from volcano_tpu.scheduler.util import scheduler_helper as helper
-from volcano_tpu.scheduler.util.priority_queue import PriorityQueue
+from volcano_tpu.scheduler.util.priority_queue import (
+    PriorityQueue,
+    make_task_queue,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -135,12 +138,11 @@ class AllocateAction(Action):
 
             job: JobInfo = jobs.pop()
             if job.uid not in pending_tasks:
-                tasks = PriorityQueue(ssn.task_order_fn)
-                for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
-                    if task.resreq.is_empty():
-                        continue  # BestEffort handled by backfill
-                    tasks.push(task)
-                pending_tasks[job.uid] = tasks
+                pending_tasks[job.uid] = make_task_queue(ssn, [
+                    task for task in job.task_status_index.get(
+                        TaskStatus.PENDING, {}).values()
+                    if not task.resreq.is_empty()  # BestEffort -> backfill
+                ])
             tasks = pending_tasks[job.uid]
 
             stmt = ssn.statement()

@@ -17,7 +17,10 @@ from volcano_tpu.api.types import TaskStatus
 from volcano_tpu.scheduler import metrics
 from volcano_tpu.scheduler.framework.interface import Action
 from volcano_tpu.scheduler.util import scheduler_helper as helper
-from volcano_tpu.scheduler.util.priority_queue import PriorityQueue
+from volcano_tpu.scheduler.util.priority_queue import (
+    PriorityQueue,
+    make_task_queue,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -39,7 +42,7 @@ class PreemptAction(Action):
             if view is not None else None
 
         preemptors_map: Dict[str, PriorityQueue] = {}
-        preemptor_tasks: Dict[str, PriorityQueue] = {}
+        preemptor_tasks: Dict[str, object] = {}
         under_request: List = []
         queues: Dict[str, object] = {}
 
@@ -59,9 +62,8 @@ class PreemptAction(Action):
                     preemptors_map[job.queue] = PriorityQueue(ssn.job_order_fn)
                 preemptors_map[job.queue].push(job)
                 under_request.append(job)
-                preemptor_tasks[job.uid] = PriorityQueue(ssn.task_order_fn)
-                for task in job.task_status_index[TaskStatus.PENDING].values():
-                    preemptor_tasks[job.uid].push(task)
+                preemptor_tasks[job.uid] = make_task_queue(
+                    ssn, job.task_status_index[TaskStatus.PENDING].values())
 
         for queue in queues.values():
             # Preemption between jobs within the queue.
@@ -175,9 +177,7 @@ def _preempt(ssn, stmt, preemptor, nodes, task_filter, view=None,
         resreq = preemptor.init_resreq.clone()
 
         # lowest-priority victims first (inverse task order)
-        victims_queue = PriorityQueue(lambda l, r: not ssn.task_order_fn(l, r))
-        for victim in victims:
-            victims_queue.push(victim)
+        victims_queue = make_task_queue(ssn, victims, reverse=True)
         while not victims_queue.empty():
             preemptee = victims_queue.pop()
             try:

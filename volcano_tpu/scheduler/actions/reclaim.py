@@ -18,7 +18,10 @@ from volcano_tpu.api.types import TaskStatus
 from volcano_tpu.api.unschedule_info import FitFailure
 from volcano_tpu.scheduler.framework.interface import Action
 from volcano_tpu.scheduler.util import scheduler_helper as helper
-from volcano_tpu.scheduler.util.priority_queue import PriorityQueue
+from volcano_tpu.scheduler.util.priority_queue import (
+    PriorityQueue,
+    make_task_queue,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -41,7 +44,7 @@ class ReclaimAction(Action):
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_set = set()
         preemptors_map: Dict[str, PriorityQueue] = {}
-        preemptor_tasks: Dict[str, PriorityQueue] = {}
+        preemptor_tasks: Dict[str, object] = {}
 
         for job in ssn.jobs.values():
             if job.pod_group.status.phase == objects.PodGroupPhase.PENDING:
@@ -59,9 +62,8 @@ class ReclaimAction(Action):
                 if job.queue not in preemptors_map:
                     preemptors_map[job.queue] = PriorityQueue(ssn.job_order_fn)
                 preemptors_map[job.queue].push(job)
-                preemptor_tasks[job.uid] = PriorityQueue(ssn.task_order_fn)
-                for task in job.task_status_index[TaskStatus.PENDING].values():
-                    preemptor_tasks[job.uid].push(task)
+                preemptor_tasks[job.uid] = make_task_queue(
+                    ssn, job.task_status_index[TaskStatus.PENDING].values())
 
         while not queues.empty():
             queue = queues.pop()

@@ -46,6 +46,7 @@ class Session:
         self.job_order_fns: Dict[str, Callable] = {}
         self.queue_order_fns: Dict[str, Callable] = {}
         self.task_order_fns: Dict[str, Callable] = {}
+        self.task_order_keys: Dict[str, Callable] = {}
         self.namespace_order_fns: Dict[str, Callable] = {}
         self.predicate_fns: Dict[str, Callable] = {}
         self.node_order_fns: Dict[str, Callable] = {}
@@ -61,6 +62,8 @@ class Session:
         self.job_enqueueable_fns: Dict[str, Callable] = {}
 
         self._tier_fns_cache: Dict[tuple, List[List[Callable]]] = {}
+        self._flat_fns_cache: Dict[tuple, List[Callable]] = {}
+        self._stock_task_key_memo = None
         self._node_order_pairs_cache = None
 
     # ------------------------------------------------------------------
@@ -74,8 +77,15 @@ class Session:
     def add_queue_order_fn(self, name: str, fn) -> None:
         self.queue_order_fns[name] = fn
 
-    def add_task_order_fn(self, name: str, fn) -> None:
+    def add_task_order_fn(self, name: str, fn, key=None) -> None:
+        """fn(l_task, r_task) -> int comparator; ``key`` optionally
+        registers an equivalent sort KEY (key(task) -> tuple ordering
+        ascending exactly as the comparator orders) — when every enabled
+        task-order plugin provides one, hot loops use one C-level key sort
+        instead of a comparator heap (see stock_task_order_key)."""
         self.task_order_fns[name] = fn
+        if key is not None:
+            self.task_order_keys[name] = key
 
     def add_namespace_order_fn(self, name: str, fn) -> None:
         self.namespace_order_fns[name] = fn
@@ -213,11 +223,19 @@ class Session:
         return True
 
     def _order(self, flag_name: str, fns, l, r) -> int:
-        for tier_fns in self._tier_plugins(flag_name, fns):
-            for fn in tier_fns:
-                j = fn(l, r)
-                if j != 0:
-                    return j
+        # flattened twin of the _tier_plugins memo: comparators run per
+        # PAIR in the priority-queue hot loops, so even the nested-list
+        # iteration overhead is worth hoisting (tier order preserved)
+        key = (flag_name, id(fns), len(fns))
+        flat = self._flat_fns_cache.get(key)
+        if flat is None:
+            flat = self._flat_fns_cache[key] = [
+                fn for tier_fns in self._tier_plugins(flag_name, fns)
+                for fn in tier_fns]
+        for fn in flat:
+            j = fn(l, r)
+            if j != 0:
+                return j
         return 0
 
     def job_order_fn(self, l: JobInfo, r: JobInfo) -> bool:
@@ -256,6 +274,46 @@ class Session:
         if lt == rt:
             return l.uid < r.uid
         return lt < rt
+
+    def stock_task_order_key(self):
+        """A sort KEY totally ordering tasks exactly like task_order_fn, or
+        None when some enabled comparator has no registered key twin
+        (add_task_order_fn's ``key``). With a key, hot loops replace
+        comparator heaps (one Python dispatch per PAIR) with one C-level
+        sort (one key per ITEM). The composed tuple is (plugin keys in tier
+        order..., ctime, uid) — the comparator chain plus task_order_fn's
+        tie-break. Memoized on the registry size (fns only ADD during
+        open)."""
+        memo = self._stock_task_key_memo
+        if memo is not None and memo[0] == len(self.task_order_fns):
+            return memo[1]
+        enabled = [
+            plugin.name
+            for tier in self.tiers
+            for plugin in tier.plugins
+            if conf.enabled(plugin.enabled_task_order)
+            and plugin.name in self.task_order_fns
+        ]
+        if any(name not in self.task_order_keys for name in enabled):
+            key = None
+        else:
+            plugin_keys = [self.task_order_keys[name] for name in enabled]
+            if not plugin_keys:
+                key = lambda t: (  # noqa: E731
+                    t.pod.metadata.creation_timestamp if t.pod else 0, t.uid)
+            elif len(plugin_keys) == 1:
+                k0 = plugin_keys[0]
+                key = lambda t: (  # noqa: E731
+                    k0(t),
+                    t.pod.metadata.creation_timestamp if t.pod else 0,
+                    t.uid)
+            else:
+                key = lambda t: (  # noqa: E731
+                    *(k(t) for k in plugin_keys),
+                    t.pod.metadata.creation_timestamp if t.pod else 0,
+                    t.uid)
+        self._stock_task_key_memo = (len(self.task_order_fns), key)
+        return key
 
     def predicate_fn(self, task: TaskInfo, node: NodeInfo) -> None:
         """Chains all enabled predicates; raises FitFailure on first miss."""

@@ -38,6 +38,7 @@ class DrfPlugin(Plugin):
         self.total_resource = Resource.empty()
         self.job_attrs: Dict[str, _Attr] = {}
         self.namespace_opts: Dict[str, _Attr] = {}
+        self._total_pairs = None  # (total, [(name, value)]) memo
 
     def name(self) -> str:
         return PLUGIN_NAME
@@ -50,9 +51,18 @@ class DrfPlugin(Plugin):
         return False
 
     def _calculate_share(self, allocated: Resource, total: Resource):
+        # total is static after on_session_open; its (name, value) pairs
+        # are materialized once — this runs per task transition event in
+        # the preempt/reclaim hot loops
+        pairs = self._total_pairs
+        if pairs is None or pairs[0] is not total:
+            pairs = self._total_pairs = (
+                total, [(rn, total.get(rn)) for rn in total.resource_names()])
         res, dominant = 0.0, ""
-        for rn in total.resource_names():
-            s = share_fn(allocated.get(rn), total.get(rn))
+        get = allocated.get
+        for rn, tv in pairs[1]:
+            l = get(rn)
+            s = ((0.0 if l == 0 else 1.0) if tv == 0 else l / tv)
             if s > res:
                 res = s
                 dominant = rn

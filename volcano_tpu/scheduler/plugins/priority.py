@@ -21,7 +21,9 @@ class PriorityPlugin(Plugin):
                 return 0
             return -1 if l.priority > r.priority else 1
 
-        ssn.add_task_order_fn(PLUGIN_NAME, task_order_fn)
+        # key twin of the comparator: higher priority sorts first
+        ssn.add_task_order_fn(PLUGIN_NAME, task_order_fn,
+                              key=lambda t: -t.priority)
 
         def job_order_fn(l, r) -> int:
             if l.priority > r.priority:

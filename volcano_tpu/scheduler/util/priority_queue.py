@@ -48,3 +48,47 @@ class PriorityQueue:
 
     def __len__(self) -> int:
         return len(self._heap)
+
+
+def make_task_queue(ssn, items, reverse: bool = False):
+    """Build-then-drain task queue ordered by the session's task order:
+    a SortedTaskQueue when the session exposes an equivalent sort key
+    (Session.stock_task_order_key), else a comparator PriorityQueue.
+    ``reverse`` inverts the order (the preempt victim cut)."""
+    key = ssn.stock_task_order_key()
+    if key is not None:
+        return SortedTaskQueue(items, key, reverse=reverse)
+    if reverse:
+        q = PriorityQueue(lambda l, r: not ssn.task_order_fn(l, r))
+    else:
+        q = PriorityQueue(ssn.task_order_fn)
+    for item in items:
+        q.push(item)
+    return q
+
+
+class SortedTaskQueue:
+    """PriorityQueue-compatible pop/empty over a batch of items sorted ONCE
+    by a key function (no comparator dispatch per pair). Valid only for the
+    build-then-drain pattern — push after the first pop is a bug, and the
+    caller must have verified the key matches the session's comparator
+    (Session.stock_task_order_key)."""
+
+    __slots__ = ("_items", "_pos")
+
+    def __init__(self, items, key, reverse: bool = False):
+        self._items = sorted(items, key=key, reverse=reverse)
+        self._pos = 0
+
+    def pop(self):
+        if self._pos >= len(self._items):
+            return None
+        v = self._items[self._pos]
+        self._pos += 1
+        return v
+
+    def empty(self) -> bool:
+        return self._pos >= len(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items) - self._pos

@@ -18,7 +18,7 @@
 
 static PyObject *s_node_name, *s_status, *s_uid, *s_namespace, *s_name,
     *s_tasks, *s_pod, *s_status_version, *s_task_status_index, *s_allocated,
-    *s_key;
+    *s_key, *s_acct_gen;
 
 /* apply_job_tasks(tis, task_infos, assign, node_names, binding,
  *                 s_pending, s_binding, c_tasks, c_pending, c_binding,
@@ -238,11 +238,11 @@ res_add_vec(PyObject *res, const double *vec, Py_ssize_t R,
     return 0;
 }
 
-/* job._status_version += 1 */
+/* obj.<name> += 1 for integer version/generation counters */
 static int
-bump_version(PyObject *job)
+bump_int_attr(PyObject *obj, PyObject *name)
 {
-    PyObject *v = PyObject_GetAttr(job, s_status_version);
+    PyObject *v = PyObject_GetAttr(obj, name);
     if (v == NULL)
         return -1;
     long long x = PyLong_AsLongLong(v);
@@ -252,10 +252,12 @@ bump_version(PyObject *job)
     PyObject *nv = PyLong_FromLongLong(x + 1);
     if (nv == NULL)
         return -1;
-    int rc = PyObject_SetAttr(job, s_status_version, nv);
+    int rc = PyObject_SetAttr(obj, name, nv);
     Py_DECREF(nv);
     return rc;
 }
+
+#define bump_version(job) bump_int_attr((job), s_status_version)
 
 /* dict.pop(uid, None) where only absence is swallowed */
 static int
@@ -520,7 +522,9 @@ apply_all_jobs(PyObject *self, PyObject *args)
                 goto job_fail;
             }
 
-            /* session node task-map (lazy dict resolve per node) */
+            /* session node task-map (lazy dict resolve per node); the
+             * resolve also bumps the node's accounting generation ONCE —
+             * any touched node invalidates the snapshot node-axis capture */
             if (ntasks[ni] == NULL) {
                 PyObject *node = PyDict_GetItemWithError(ssn_nodes, host);
                 if (node == NULL) {
@@ -528,6 +532,8 @@ apply_all_jobs(PyObject *self, PyObject *args)
                         PyErr_SetObject(PyExc_KeyError, host);
                     goto task_fail;
                 }
+                if (bump_int_attr(node, s_acct_gen) < 0)
+                    goto task_fail;
                 ntasks[ni] = PyObject_GetAttr(node, s_tasks); /* strong */
                 if (ntasks[ni] == NULL)
                     goto task_fail;
@@ -557,6 +563,8 @@ apply_all_jobs(PyObject *self, PyObject *args)
                             if (cnode == NULL && PyErr_Occurred())
                                 goto task_fail;
                             if (cnode != NULL) {
+                                if (bump_int_attr(cnode, s_acct_gen) < 0)
+                                    goto task_fail;
                                 ctasks_n[ni] =
                                     PyObject_GetAttr(cnode, s_tasks);
                                 if (ctasks_n[ni] == NULL)
@@ -709,6 +717,8 @@ apply_node_deltas(PyObject *self, PyObject *args)
                     goto done;
                 continue;
             }
+            if (bump_int_attr(node, s_acct_gen) < 0)
+                goto done;
             PyObject *idle = PyObject_GetAttr(node, s_idle);
             if (idle == NULL)
                 goto done;
@@ -901,9 +911,10 @@ PyInit__fastapply(void)
     s_task_status_index = PyUnicode_InternFromString("task_status_index");
     s_allocated = PyUnicode_InternFromString("allocated");
     s_key = PyUnicode_InternFromString("key");
+    s_acct_gen = PyUnicode_InternFromString("_acct_gen");
     if (!s_node_name || !s_status || !s_uid || !s_namespace || !s_name ||
         !s_tasks || !s_pod || !s_status_version || !s_task_status_index ||
-        !s_allocated || !s_key)
+        !s_allocated || !s_key || !s_acct_gen)
         return NULL;
     return PyModule_Create(&moduledef);
 }

@@ -12,13 +12,17 @@ from volcano_tpu.api.queue_info import QueueInfo
 
 
 class ClusterInfo:
-    __slots__ = ("jobs", "nodes", "queues", "namespace_info")
+    __slots__ = ("jobs", "nodes", "queues", "namespace_info", "node_axis")
 
     def __init__(self):
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
         self.queues: Dict[str, QueueInfo] = {}
         self.namespace_info: Dict[str, NamespaceInfo] = {}
+        # columnar capture of the ready nodes (cache/nodeaxis.py), built by
+        # snapshot() in the same pass that clones them; None when the
+        # embedding cache does not capture
+        self.node_axis = None
 
     def __repr__(self) -> str:
         return (

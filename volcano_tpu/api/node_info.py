@@ -35,6 +35,12 @@ class NodeInfo:
         self.used = Resource.empty()
         self.tasks: Dict[str, TaskInfo] = {}
         self.others: Dict[str, object] = {}
+        # accounting generation: bumped by every mutation of the node's
+        # resource state (add/remove/update_task, set_node, and the bulk
+        # writeback's direct idle/used deltas). The snapshot-captured
+        # columnar node axis (cache/nodeaxis.py) records it so the encoder
+        # can prove the capture still reflects this node
+        self._acct_gen = 0
 
         if node is None:
             self.name = ""
@@ -72,6 +78,7 @@ class NodeInfo:
     def set_node(self, node: objects.Node) -> None:
         """Refresh from the node object, recomputing accounting from held
         tasks (node_info.go:148-173)."""
+        self._acct_gen += 1
         self._set_node_state(node)
         if not self.ready():
             return
@@ -100,6 +107,7 @@ class NodeInfo:
 
     def add_task(self, task: TaskInfo) -> None:
         """(node_info.go:188-220)"""
+        self._acct_gen += 1
         key = pod_key(task.pod) if task.pod is not None else f"{task.namespace}/{task.name}"
         if key in self.tasks:
             raise RuntimeError(
@@ -125,6 +133,7 @@ class NodeInfo:
 
     def remove_task(self, ti: TaskInfo) -> None:
         """(node_info.go:223-249)"""
+        self._acct_gen += 1
         key = pod_key(ti.pod) if ti.pod is not None else f"{ti.namespace}/{ti.name}"
         task = self.tasks.get(key)
         if task is None:
@@ -154,6 +163,7 @@ class NodeInfo:
         per call. Transitions whose checks are REAL (from PIPELINED, or
         RELEASING->PIPELINED) and mismatched requests take the legacy
         remove+add path."""
+        self._acct_gen += 1
         key = pod_key(ti.pod) if ti.pod is not None else f"{ti.namespace}/{ti.name}"
         cur = self.tasks.get(key)
         if cur is None:

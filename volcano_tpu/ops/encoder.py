@@ -303,7 +303,7 @@ def _promote_exclusive(all_tasks, cand_idx, bulk_universe_idx, nodes,
 
 
 def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue,
-                    batch_on=False):
+                    batch_on=False, node_scalars=None):
     """Columnar task axis: validated gathers from the cache's pod table
     instead of walking task objects. Returns the tuple encode_session
     unpacks, or None to fall back (stale rows, rowless tasks).
@@ -354,9 +354,15 @@ def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue,
                            np.asarray(nz_counts, np.int64))
 
     scalar_set = set(table.scalar_names())
-    for node in nodes:
-        if node.allocatable.scalar_resources:
-            scalar_set.update(node.allocatable.scalar_resources)
+    if node_scalars is not None:
+        # snapshot node-axis capture already unioned the node scalars
+        # (may over-include all-zero dims — harmless, same caveat as
+        # table.scalar_names)
+        scalar_set.update(node_scalars)
+    else:
+        for node in nodes:
+            if node.allocatable.scalar_resources:
+                scalar_set.update(node.allocatable.scalar_resources)
     rnames = ["cpu", "memory", *sorted(scalar_set)]
     R = len(rnames)
 
@@ -508,17 +514,39 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
         raise EncoderFallback(f"unsupported batch-node-order plugins: {batch_order}")
 
     # ---- node axis (name-sorted, = util.get_node_list order) ---------------
-    node_names = sorted(ssn.nodes)
-    nodes = [ssn.nodes[n] for n in node_names]
-    n_count = len(nodes)
-    has_releasing = False
+    # snapshot-captured columnar axis (cache/nodeaxis.py): valid only while
+    # every node's accounting generation matches the capture — any session
+    # mutation since snapshot falls back to the object walks below
+    from volcano_tpu.scheduler.cache import nodeaxis as _na
+
+    axis = getattr(ssn, "node_axis", None)
+    if axis is not None and (
+            len(axis.names) != len(ssn.nodes) or not axis.validate()):
+        axis = None
+    if axis is not None:
+        node_names = axis.names
+        nodes = axis.nodes
+        n_count = len(nodes)
+        axis_flags = axis.flags
+        has_releasing = bool((axis_flags & _na.F_RELEASING).any())
+        if has_releasing and not allow_residue:
+            raise EncoderFallback("releasing resources (pipeline path) not modeled")
+        resident_idx = np.nonzero(axis_flags & _na.F_RESIDENT_PODS)[0]
+    else:
+        node_names = sorted(ssn.nodes)
+        nodes = [ssn.nodes[n] for n in node_names]
+        n_count = len(nodes)
+        has_releasing = False
+        for node in nodes:
+            if not node.releasing.is_empty():
+                if not allow_residue:
+                    raise EncoderFallback(
+                        "releasing resources (pipeline path) not modeled")
+                has_releasing = True
+        resident_idx = [ni for ni, node in enumerate(nodes) if node.tasks]
     sym_terms = []  # (anti-affinity term, owner namespace, node index)
-    for ni, node in enumerate(nodes):
-        if not node.releasing.is_empty():
-            if not allow_residue:
-                raise EncoderFallback("releasing resources (pipeline path) not modeled")
-            has_releasing = True
-        for t in node.tasks.values():
+    for ni in resident_idx:
+        for t in nodes[ni].tasks.values():
             if t.pod is None:
                 continue
             _, ports, aff = _pod_encode_traits(t.pod)
@@ -567,7 +595,8 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
     if table is not None and not sym_active and task_order_plugins <= {"priority"}:
         fast = _fast_task_axis(
             jobs, j_count, nodes, table, bool(task_order_plugins),
-            allow_residue, batch_on="nodeorder" in batch_order)
+            allow_residue, batch_on="nodeorder" in batch_order,
+            node_scalars=axis.scalar_names if axis is not None else None)
 
     excl_occ_rows: list = []
     if fast is not None:
@@ -776,19 +805,32 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
 
     sig_mask = np.ones((s_count, n_count), bool)
     if predicates_on:
-        node_ok = np.array(
-            [_static_node_ok(n, memory_p, disk_p, pid_p) for n in nodes]
-        )
-        # nodes carrying schedulability-affecting taints, computed once: a
-        # selector-free pod only needs per-node work on THOSE nodes, which
-        # drops the common no-selector/no-taint signature from O(N) Python
-        # calls to one mask copy
-        tainted = [
-            ni for ni, n in enumerate(nodes)
-            if n.node is not None and any(
-                t.effect in ("NoSchedule", "NoExecute")
-                for t in n.node.spec.taints)
-        ]
+        if axis is not None:
+            f = axis.flags
+            node_ok = ((f & _na.F_READY) != 0) \
+                & ((f & _na.F_NET_UNAVAILABLE) == 0) \
+                & ((f & _na.F_UNSCHEDULABLE) == 0)
+            if memory_p:
+                node_ok &= (f & _na.F_MEM_PRESSURE) == 0
+            if disk_p:
+                node_ok &= (f & _na.F_DISK_PRESSURE) == 0
+            if pid_p:
+                node_ok &= (f & _na.F_PID_PRESSURE) == 0
+            tainted = np.nonzero(f & _na.F_BLOCKING_TAINTS)[0].tolist()
+        else:
+            node_ok = np.array(
+                [_static_node_ok(n, memory_p, disk_p, pid_p) for n in nodes]
+            )
+            # nodes carrying schedulability-affecting taints, computed
+            # once: a selector-free pod only needs per-node work on THOSE
+            # nodes, which drops the common no-selector/no-taint signature
+            # from O(N) Python calls to one mask copy
+            tainted = [
+                ni for ni, n in enumerate(nodes)
+                if n.node is not None and any(
+                    t.effect in ("NoSchedule", "NoExecute")
+                    for t in n.node.spec.taints)
+            ]
         for si, rep in enumerate(sig_rep):
             pod = rep.pod
             if pod is None:
@@ -861,6 +903,17 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
 
     # ---- node state (column-wise fills, like the task arrays) --------------
     def _node_matrix(attr: str) -> np.ndarray:
+        if axis is not None:
+            cap_attr = "alloc" if attr == "allocatable" else attr
+            m = np.zeros((n_count, R), np.float64)
+            m[:, 0] = axis.cpu[cap_attr]
+            m[:, 1] = axis.mem[cap_attr]
+            cols = axis.scalars[cap_attr]
+            for si, rn in enumerate(rnames[2:], start=2):
+                col = cols.get(rn)
+                if col is not None:
+                    m[:, si] = col
+            return m
         if not nodes:
             return np.zeros((0, R))
         m = np.zeros((n_count, R), np.float64)
@@ -901,8 +954,13 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
             raise EncoderFallback(
                 "total pending request exceeds the limb-exact cumsum range "
                 f"({total_req_q.max():.3g} units)")
-    node_cnt = np.array([len(n.tasks) for n in nodes], np.int32)
-    node_max_tasks = np.array([n.allocatable.max_task_num for n in nodes], np.int32)
+    if axis is not None:
+        node_cnt = axis.node_cnt
+        node_max_tasks = axis.max_tasks
+    else:
+        node_cnt = np.array([len(n.tasks) for n in nodes], np.int32)
+        node_max_tasks = np.array(
+            [n.allocatable.max_task_num for n in nodes], np.int32)
 
     # ---- queues / namespaces ----------------------------------------------
     ns_names = sorted({job.namespace for job in jobs})

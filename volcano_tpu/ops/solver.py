@@ -590,7 +590,9 @@ class BatchAllocator:
                     # the share is safe and saves one object per
                     # placement)
                     key = task.key
-                    ssn_nodes[host].tasks[key] = task
+                    node = ssn_nodes[host]
+                    node._acct_gen += 1  # invalidate snapshot node-axis
+                    node.tasks[key] = task
                     if c_tasks is not None:
                         ctask = c_tasks.get(uid)
                         if ctask is not None:
@@ -601,6 +603,7 @@ class BatchAllocator:
                                 c_binding[uid] = ctask
                             cnode = cache_nodes.get(host)
                             if cnode is not None:
+                                cnode._acct_gen += 1
                                 cnode.tasks[key] = task
                     # effector contract matches session.dispatch ->
                     # cache.bind (cache.py:374-395): volumes, binder
@@ -706,6 +709,7 @@ class BatchAllocator:
                 for node in (ssn_nodes.get(name), cache_nodes.get(name)):
                     if node is None:
                         continue
+                    node._acct_gen += 1  # invalidate snapshot node-axis
                     apply_delta(node.idle, vec, -1.0)
                     apply_delta(node.used, vec, +1.0)
 

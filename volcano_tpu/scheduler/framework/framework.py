@@ -54,6 +54,7 @@ def close_session(ssn: Session) -> None:
 
     ssn.jobs = {}
     ssn.nodes = {}
+    ssn.node_axis = None  # releases the snapshot's cloned NodeInfos too
     ssn.plugins = {}
     ssn.event_handlers = []
     ssn.job_order_fns = {}

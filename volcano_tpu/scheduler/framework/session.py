@@ -37,6 +37,7 @@ class Session:
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
         self.queues: Dict[str, QueueInfo] = {}
+        self.node_axis = None  # snapshot columnar node capture (nodeaxis.py)
         self.namespace_info: Dict[str, object] = {}
 
         self.tiers: List[conf.Tier] = []
@@ -520,3 +521,4 @@ def open_session_state(ssn: Session) -> None:
     ssn.nodes = snapshot.nodes
     ssn.queues = snapshot.queues
     ssn.namespace_info = snapshot.namespace_info
+    ssn.node_axis = snapshot.node_axis

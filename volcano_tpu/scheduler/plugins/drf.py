@@ -13,7 +13,6 @@ import math
 from typing import Dict, List
 
 from volcano_tpu.api.resource import Resource
-from volcano_tpu.api.share_helpers import share as share_fn
 from volcano_tpu.api.types import allocated_status
 from volcano_tpu.scheduler import conf
 from volcano_tpu.scheduler.framework.event_handlers import EventHandler

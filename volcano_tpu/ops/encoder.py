@@ -775,6 +775,18 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
             row_bytes, return_index=True, return_inverse=True)
         task_cls = task_cls.astype(np.int32)
         cls_rows = cls_key[first_idx]
+        excl_col = cls_rows[:, 2 * R + 2]
+        if (excl_col >= 0).any():
+            # exclusion-group classes first: they place in the earliest
+            # rounds (grank spreading), their chunks then go dead, and the
+            # kernel's dead-chunk skip drops the per-round sweep from
+            # ceil(K/CHUNK) chunks to the few still-live plain ones —
+            # class ids carry no other semantics
+            perm = np.argsort(excl_col < 0, kind="stable")
+            inv = np.empty(perm.size, np.int32)
+            inv[perm] = np.arange(perm.size, dtype=np.int32)
+            task_cls = inv[task_cls]
+            cls_rows = cls_rows[perm]
         k_count = cls_rows.shape[0]
         cls_req = cls_rows[:, :R]
         cls_initreq = cls_rows[:, R:2 * R]

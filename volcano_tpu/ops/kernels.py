@@ -130,6 +130,13 @@ class SolveSpec(NamedTuple):
     # rounds-only: device-placed required-anti-affinity exclusion groups
     # (encoder._promote_exclusive); flips only when such workloads appear
     use_exclusion: bool = False
+    # rounds-only: diminishing-returns exit. When a round places fewer than
+    # this many tasks (but more than zero), the solve stops and marks every
+    # still-wanting task for the serial residue pass (assign = -2) — a
+    # handful of host-side placements beat another fixed-cost device round.
+    # 0 disables (the parity path and small solves). Static per task
+    # bucket, so it never causes steady-state retraces.
+    round_min_progress: int = 0
 
 
 def fused_scores(spec: SolveSpec, enc, used, req, nz_cpu, nz_mem, sig):

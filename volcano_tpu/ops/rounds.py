@@ -84,7 +84,8 @@ def _job_rank(spec: SolveSpec, enc, job_placed, job_alloc):
     return jnp.zeros(j, jnp.int32).at[order].set(jnp.arange(j, dtype=jnp.int32))
 
 
-def _choices(spec: SolveSpec, enc, idle, used, cnt, active, excl_occ=None):
+def _choices(spec: SolveSpec, enc, idle, used, cnt, active, excl_occ=None,
+             compact=False):
     """Per-task node choice via task equivalence classes.
 
     Tasks stamped from one template share (req, initreq, signature,
@@ -126,102 +127,113 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active, excl_occ=None):
     chunk = min(CHUNK, k_total)  # both powers of two (solver buckets)
     n_chunks = k_total // chunk
 
+    def sweep_rows(req, initreq, sig, nz_cpu, nz_mem, has_pod, exl, frac,
+                   live_rows):
+        """The (rows x N) feasibility/score/capacity sweep over a batch of
+        class rows — either a contiguous chunk or a gathered compaction of
+        the live classes. Dead rows (live_rows False) come out all-masked
+        (n_feas 0), so their tasks never produce a choice."""
+        rows = req.shape[0]
+        # epsilon fit of init requests against idle (resource_info.go:267)
+        le = initreq[:, None, :] < idle[None, :, :] + eps[None, None, :]
+        skip = is_scalar[None, None, :] & (initreq[:, None, :] <= MIN_MILLI_SCALAR)
+        fit = jnp.all(le | skip, axis=-1)                     # [C, N]
+        mask = fit & enc["sig_mask"][sig] & live_rows[:, None]
+        if spec.check_pod_count:
+            mask = mask & ((cnt[None, :] < enc["node_max_tasks"][None, :])
+                           | ~has_pod[:, None])
+        if spec.use_exclusion:
+            # exclusion-group classes: nodes already holding a group
+            # member (resident at encode, or committed in an earlier
+            # round) are infeasible for the whole class
+            occ = excl_occ[jnp.maximum(exl, 0)]              # [C, N]
+            mask = mask & ~(occ & (exl >= 0)[:, None])
+
+        score = fused_scores(spec, enc, used, req, nz_cpu, nz_mem, sig)
+        masked = jnp.where(mask, score, neg)
+        # capacity-aware spreading: rank the class's feasible nodes by
+        # descending score (stable => ascending node index on ties, the
+        # serial tie-break), estimate how many of THIS class each node
+        # can hold, and hand the class's i-th task a node where i falls
+        # in cumulative capacity — INTERLEAVED across equal-score
+        # groups. Why both mechanisms: score-concentrating policies
+        # (binpack) would otherwise send every task of a class to the
+        # one best node and the bulk-synchronous round fills a single
+        # node's prefix (measured: 89 rounds at cfg2), while spreading
+        # policies (least-requested) tie whole groups of nodes whose
+        # serial behavior is round-robin; the capacity walk handles the
+        # former, the within-group rotation the latter. _resolve's
+        # exact prefix acceptance cleans up the optimistic tail.
+        order = jnp.argsort(-masked, axis=-1, stable=True)  # [C, N]
+        # per-(class, node) capacity estimate from per-dim idle/req
+        # (advisory only — real feasibility stays with _resolve)
+        safe_req = jnp.maximum(req, eps[None, :])
+        cap_dim = idle[None, :, :] / safe_req[:, None, :]   # [C, N, R]
+        cap = jnp.min(
+            jnp.where((req > 0)[:, None, :], cap_dim, jnp.inf), axis=-1)
+        big = jnp.asarray(float(t_cap), idle.dtype)
+        cap = jnp.minimum(jnp.where(jnp.isinf(cap), big, cap), big)
+        if spec.use_binpack:
+            cap = cap * frac[:, None]
+        if spec.use_exclusion:
+            # at most one group member per node, ever
+            cap = jnp.where((exl >= 0)[:, None],
+                            jnp.minimum(cap, 1.0), cap)
+        if spec.check_pod_count:
+            pod_room = (enc["node_max_tasks"] - cnt)[None, :].astype(cap.dtype)
+            cap = jnp.where(has_pod[:, None],
+                            jnp.minimum(cap, pod_room), cap)
+        cap = jnp.where(mask, jnp.floor(cap), 0.0)
+        cap = jnp.maximum(cap, jnp.where(mask, 1.0, 0.0))  # >=1 if feasible
+        cap_i = cap.astype(jnp.int32)
+        # SATURATING prefix sum at t_cap (> any rank): a plain int32
+        # cumsum can wrap at N*(T+1); saturating add of non-negatives
+        # is associative, so the scan stays exact and monotone with
+        # every partial <= 2*t_cap
+        ccap = lax.associative_scan(
+            lambda a, b: jnp.minimum(a + b, jnp.int32(t_cap)),
+            jnp.take_along_axis(cap_i, order, axis=-1), axis=1)  # [C, N]
+
+        # equal-score groups along the ordered axis (for the rotation)
+        score_ord = jnp.take_along_axis(masked, order, axis=-1)
+        pos = jnp.broadcast_to(
+            jnp.arange(n_total, dtype=jnp.int32)[None, :],
+            (rows, n_total))
+        is_start = jnp.concatenate(
+            [jnp.ones((rows, 1), bool),
+             score_ord[:, 1:] != score_ord[:, :-1]], axis=1)
+        g_start = lax.cummax(jnp.where(is_start, pos, 0), axis=1)
+        starts = jnp.where(is_start, pos, jnp.int32(n_total))
+        # next group start AFTER j: suffix-min of starts, shifted left
+        sfx = jnp.flip(lax.cummin(jnp.flip(starts, axis=1), axis=1), axis=1)
+        g_end = jnp.concatenate(
+            [sfx[:, 1:], jnp.full((rows, 1), n_total, jnp.int32)], axis=1)
+        g_size = g_end - g_start
+        ccap_before = jnp.where(
+            g_start > 0,
+            jnp.take_along_axis(ccap, jnp.maximum(g_start - 1, 0), axis=1),
+            0)
+        n_feas = jnp.sum(mask, axis=-1).astype(jnp.int32)
+        return (order.astype(jnp.int32), ccap, g_start, g_size,
+                ccap_before, n_feas)
+
     def one_chunk(ci):
         sl = ci * chunk
         live = lax.dynamic_slice_in_dim(cls_live, sl, chunk)
 
         def sweep(_):
-            req = lax.dynamic_slice_in_dim(enc["cls_req"], sl, chunk)
-            initreq = lax.dynamic_slice_in_dim(enc["cls_initreq"], sl, chunk)
-            sig = lax.dynamic_slice_in_dim(enc["cls_sig"], sl, chunk)
-            nz_cpu = lax.dynamic_slice_in_dim(enc["cls_nz_cpu"], sl, chunk)
-            nz_mem = lax.dynamic_slice_in_dim(enc["cls_nz_mem"], sl, chunk)
-            has_pod = lax.dynamic_slice_in_dim(enc["cls_has_pod"], sl, chunk)
-
-            # epsilon fit of init requests against idle (resource_info.go:267)
-            le = initreq[:, None, :] < idle[None, :, :] + eps[None, None, :]
-            skip = is_scalar[None, None, :] & (initreq[:, None, :] <= MIN_MILLI_SCALAR)
-            fit = jnp.all(le | skip, axis=-1)                     # [C, N]
-            mask = fit & enc["sig_mask"][sig]
-            if spec.check_pod_count:
-                mask = mask & ((cnt[None, :] < enc["node_max_tasks"][None, :])
-                               | ~has_pod[:, None])
-            if spec.use_exclusion:
-                # exclusion-group classes: nodes already holding a group
-                # member (resident at encode, or committed in an earlier
-                # round) are infeasible for the whole class
-                exl = lax.dynamic_slice_in_dim(enc["cls_excl"], sl, chunk)
-                occ = excl_occ[jnp.maximum(exl, 0)]              # [C, N]
-                mask = mask & ~(occ & (exl >= 0)[:, None])
-
-            score = fused_scores(spec, enc, used, req, nz_cpu, nz_mem, sig)
-            masked = jnp.where(mask, score, neg)
-            # capacity-aware spreading: rank the class's feasible nodes by
-            # descending score (stable => ascending node index on ties, the
-            # serial tie-break), estimate how many of THIS class each node
-            # can hold, and hand the class's i-th task a node where i falls
-            # in cumulative capacity — INTERLEAVED across equal-score
-            # groups. Why both mechanisms: score-concentrating policies
-            # (binpack) would otherwise send every task of a class to the
-            # one best node and the bulk-synchronous round fills a single
-            # node's prefix (measured: 89 rounds at cfg2), while spreading
-            # policies (least-requested) tie whole groups of nodes whose
-            # serial behavior is round-robin; the capacity walk handles the
-            # former, the within-group rotation the latter. _resolve's
-            # exact prefix acceptance cleans up the optimistic tail.
-            order = jnp.argsort(-masked, axis=-1, stable=True)  # [C, N]
-            # per-(class, node) capacity estimate from per-dim idle/req
-            # (advisory only — real feasibility stays with _resolve)
-            safe_req = jnp.maximum(req, eps[None, :])
-            cap_dim = idle[None, :, :] / safe_req[:, None, :]   # [C, N, R]
-            cap = jnp.min(
-                jnp.where((req > 0)[:, None, :], cap_dim, jnp.inf), axis=-1)
-            big = jnp.asarray(float(t_cap), idle.dtype)
-            cap = jnp.minimum(jnp.where(jnp.isinf(cap), big, cap), big)
-            if spec.use_binpack:
-                frac = lax.dynamic_slice_in_dim(cls_frac, sl, chunk)
-                cap = cap * frac[:, None]
-            if spec.use_exclusion:
-                # at most one group member per node, ever
-                cap = jnp.where((exl >= 0)[:, None],
-                                jnp.minimum(cap, 1.0), cap)
-            if spec.check_pod_count:
-                pod_room = (enc["node_max_tasks"] - cnt)[None, :].astype(cap.dtype)
-                cap = jnp.where(has_pod[:, None],
-                                jnp.minimum(cap, pod_room), cap)
-            cap = jnp.where(mask, jnp.floor(cap), 0.0)
-            cap = jnp.maximum(cap, jnp.where(mask, 1.0, 0.0))  # >=1 if feasible
-            cap_i = cap.astype(jnp.int32)
-            # SATURATING prefix sum at t_cap (> any rank): a plain int32
-            # cumsum can wrap at N*(T+1); saturating add of non-negatives
-            # is associative, so the scan stays exact and monotone with
-            # every partial <= 2*t_cap
-            ccap = lax.associative_scan(
-                lambda a, b: jnp.minimum(a + b, jnp.int32(t_cap)),
-                jnp.take_along_axis(cap_i, order, axis=-1), axis=1)  # [C, N]
-
-            # equal-score groups along the ordered axis (for the rotation)
-            score_ord = jnp.take_along_axis(masked, order, axis=-1)
-            pos = jnp.broadcast_to(
-                jnp.arange(n_total, dtype=jnp.int32)[None, :],
-                (chunk, n_total))
-            is_start = jnp.concatenate(
-                [jnp.ones((chunk, 1), bool),
-                 score_ord[:, 1:] != score_ord[:, :-1]], axis=1)
-            g_start = lax.cummax(jnp.where(is_start, pos, 0), axis=1)
-            starts = jnp.where(is_start, pos, jnp.int32(n_total))
-            # next group start AFTER j: suffix-min of starts, shifted left
-            sfx = jnp.flip(lax.cummin(jnp.flip(starts, axis=1), axis=1), axis=1)
-            g_end = jnp.concatenate(
-                [sfx[:, 1:], jnp.full((chunk, 1), n_total, jnp.int32)], axis=1)
-            g_size = g_end - g_start
-            ccap_before = jnp.where(
-                g_start > 0,
-                jnp.take_along_axis(ccap, jnp.maximum(g_start - 1, 0), axis=1),
-                0)
-            n_feas = jnp.sum(mask, axis=-1).astype(jnp.int32)
-            return (order.astype(jnp.int32), ccap, g_start, g_size,
-                    ccap_before, n_feas)
+            return sweep_rows(
+                lax.dynamic_slice_in_dim(enc["cls_req"], sl, chunk),
+                lax.dynamic_slice_in_dim(enc["cls_initreq"], sl, chunk),
+                lax.dynamic_slice_in_dim(enc["cls_sig"], sl, chunk),
+                lax.dynamic_slice_in_dim(enc["cls_nz_cpu"], sl, chunk),
+                lax.dynamic_slice_in_dim(enc["cls_nz_mem"], sl, chunk),
+                lax.dynamic_slice_in_dim(enc["cls_has_pod"], sl, chunk),
+                lax.dynamic_slice_in_dim(enc["cls_excl"], sl, chunk)
+                if spec.use_exclusion else None,
+                lax.dynamic_slice_in_dim(cls_frac, sl, chunk)
+                if spec.use_binpack else None,
+                live)
 
         zero_i = lambda: jnp.zeros((chunk, n_total), jnp.int32)  # noqa: E731
         return lax.cond(
@@ -230,14 +242,39 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active, excl_occ=None):
                        jnp.ones((chunk, n_total), jnp.int32), zero_i(),
                        jnp.zeros((chunk,), jnp.int32)), None)
 
-    order, ccap, g_start, g_size, ccap_before, n_feas = lax.map(
-        one_chunk, jnp.arange(n_chunks))
-    order = order.reshape(k_total, n_total)
-    ccap = ccap.reshape(k_total, n_total)
-    g_start = g_start.reshape(k_total, n_total)
-    g_size = g_size.reshape(k_total, n_total)
-    ccap_before = ccap_before.reshape(k_total, n_total)
-    n_feas = n_feas.reshape(k_total)
+    def chunked_sweep(_):
+        outs = lax.map(one_chunk, jnp.arange(n_chunks))
+        return tuple(
+            x.reshape(k_total, n_total) if x.ndim == 3 else
+            x.reshape(k_total)
+            for x in outs)
+
+    if compact and n_chunks > 1:
+        # late rounds leave a few live classes SCATTERED across chunks
+        # (exclusion stragglers early, plain leftovers late) — every chunk
+        # then pays its full (chunk x N) sweep for a handful of rows. The
+        # compact phase (solve_rounds runs it once the live count fits one
+        # chunk — monotone: classes never revive) gathers the live rows,
+        # runs a single sweep, and scatters the results back: the fixed
+        # per-round cost drops by ~n_chunks for the convergence tail.
+        # Taking exactly `chunk` rows is safe even if more are live (the
+        # ungathered classes come out all-masked and simply retry).
+        sel = jnp.argsort(~cls_live, stable=True)[:chunk]  # live first
+        o, cc, gs, gz, cb, nf = sweep_rows(
+            enc["cls_req"][sel], enc["cls_initreq"][sel],
+            enc["cls_sig"][sel], enc["cls_nz_cpu"][sel],
+            enc["cls_nz_mem"][sel], enc["cls_has_pod"][sel],
+            enc["cls_excl"][sel] if spec.use_exclusion else None,
+            cls_frac[sel] if spec.use_binpack else None,
+            cls_live[sel])
+        z = jnp.zeros((k_total, n_total), jnp.int32)
+        order, ccap, g_start, g_size, ccap_before, n_feas = (
+            z.at[sel].set(o), z.at[sel].set(cc), z.at[sel].set(gs),
+            jnp.ones((k_total, n_total), jnp.int32).at[sel].set(gz),
+            z.at[sel].set(cb),
+            jnp.zeros(k_total, jnp.int32).at[sel].set(nf))
+    else:
+        order, ccap, g_start, g_size, ccap_before, n_feas = chunked_sweep(None)
 
     t_total = task_cls.shape[0]
     # rank of each ACTIVE task within its class, in flat order: sort by
@@ -293,6 +330,35 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active, excl_occ=None):
             final = jnp.where(is_excl, rotated, slot)
         else:
             final = rotated
+    if spec.use_exclusion:
+        # same-group classes (e.g. one anti-affinity deployment whose
+        # members differ in requests and are therefore SINGLETON classes)
+        # score near-identically and would all aim at the same argmax —
+        # one winner per (group, node) per round makes convergence crawl
+        # at ~group_size rounds (measured: 33 rounds on the affinity
+        # bench). Offsetting each class by its rank among its group's LIVE
+        # classes spreads the group over distinct ordered positions within
+        # ONE round; the winner scatter + occupancy mask still enforce
+        # mutual exclusion exactly.
+        # rank of each class among its group's LIVE classes, lower class
+        # index first: one stable argsort (group-major, index-ascending)
+        # + segmented prefix count — O(K log K), not a [K, K] compare
+        exl_all = enc["cls_excl"]
+        perm = jnp.argsort(exl_all, stable=True)
+        sorted_gid = exl_all[perm]
+        sorted_live = cls_live[perm].astype(jnp.int32)
+        prefix = jnp.cumsum(sorted_live) - sorted_live  # live strictly before
+        seg_start = jnp.concatenate(
+            [jnp.ones(1, bool), sorted_gid[1:] != sorted_gid[:-1]])
+        # prefix is non-decreasing, so cummax propagates each segment's
+        # starting prefix down the segment
+        seg_base = lax.cummax(jnp.where(seg_start, prefix, 0))
+        grank = jnp.zeros(exl_all.shape[0], jnp.int32).at[perm].set(
+            (prefix - seg_base).astype(jnp.int32))
+        is_exg = enc["cls_excl"][tk] >= 0
+        spread = jnp.clip(final + grank[tk], 0,
+                          jnp.maximum(n_feas[tk] - 1, 0))
+        final = jnp.where(is_exg, spread, final)
     choice = order[tk, final]
     feasible = (n_feas[tk] > 0) & ~overflow & active
     # conservative retry choice: each task's class-best feasible node (the
@@ -494,6 +560,8 @@ def solve_rounds(spec: SolveSpec, enc: dict):
     the int32 task_cls index only)."""
     t_total = enc["task_cls"].shape[0]
     j_total = enc["job_tie_rank"].shape[0]
+    k_total = enc["cls_req"].shape[0]
+    chunk_k = min(CHUNK, k_total)
     dt = enc["cls_req"].dtype
     enc = dict(
         enc,
@@ -528,6 +596,7 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         progress=jnp.bool_(True),
         tried_cons=jnp.bool_(False),  # conservative retry owed after stall
         dead=jnp.bool_(False),  # outer fixpoint reached
+        capped=jnp.bool_(False),  # diminishing-returns exit (min_progress)
     )
     if spec.use_exclusion:
         st["excl_occ"] = enc["excl_occ0"]
@@ -535,7 +604,7 @@ def solve_rounds(spec: SolveSpec, enc: dict):
     # case, so the runaway bound is 2(T+J)+8 (see outer_body)
     round_budget = 2 * (t_total + j_total) + 8
 
-    def round_body(st):
+    def round_body(st, compact=False):
         job_rank = _job_rank(spec, enc, st["job_placed"], st["job_alloc"])
         task_rank = job_rank[task_job] * max_tasks_per_job + task_in_job
 
@@ -556,7 +625,7 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         cons = ~st["progress"]
         choice, cons_choice = _choices(
             spec, enc, st["idle"], st["used"], st["cnt"], active,
-            excl_occ=st.get("excl_occ"))
+            excl_occ=st.get("excl_occ"), compact=compact)
         choice = jnp.where(cons, cons_choice, choice)
         if spec.use_exclusion:
             # within-round mutual exclusion: of the tasks of one group
@@ -586,11 +655,27 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         used = st["used"].at[node].add(dreq)
         cnt = st["cnt"].at[node].add(accept.astype(jnp.int32))
         assign = jnp.where(accept, choice, st["assign"])
-        any_accept = jnp.any(accept)
+        placed_n = jnp.sum(accept.astype(jnp.int32))
+        any_accept = placed_n > 0
         if spec.use_exclusion:
             st = dict(st, excl_occ=st["excl_occ"].at[
                 jnp.maximum(task_excl, 0), node].max(
                     accept & (task_excl >= 0)))
+        capped = st["capped"]
+        if spec.round_min_progress > 1:
+            # diminishing-returns exit: a nonzero round below the progress
+            # floor means the remaining stragglers cost a fixed-price
+            # device round each few — the serial residue pass places them
+            # for microseconds apiece instead (assign=-2 marking below).
+            # Bounded: only when the remainder is small (<= 8x the floor,
+            # ~3% of the axis) — a large remainder is either worth more
+            # rounds or unplaceable (which ends via zero progress anyway),
+            # and must not be dumped on the serial pass wholesale
+            remaining = jnp.sum((st["active"] & ~accept).astype(jnp.int32))
+            capped = capped | (
+                any_accept & (placed_n < jnp.int32(spec.round_min_progress))
+                & (remaining > 0)
+                & (remaining <= jnp.int32(8 * spec.round_min_progress)))
         return dict(
             st,
             idle=idle, used=used, cnt=cnt, assign=assign,
@@ -602,6 +687,7 @@ def solve_rounds(spec: SolveSpec, enc: dict):
             rounds=st["rounds"] + 1,
             progress=any_accept,
             tried_cons=cons & ~any_accept,
+            capped=capped,
         )
 
     def rollback(st):
@@ -648,11 +734,37 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         # the final no-op confirmation sweep when every task is placed.
         # Budget 2(T+J): each stall pair (normal + conservative) either
         # places >= 1 task or exits to a rollback that retires one job.
-        st = lax.while_loop(
-            lambda s: (s["progress"] | ~s["tried_cons"])
-            & jnp.any(s["active"]) & (s["rounds"] < round_budget),
-            round_body, st)
-        st, _rolled = rollback(st)
+        # A capped (diminishing-returns) exit is terminal: no rollback —
+        # the serial residue pass owns the stragglers AND any still-short
+        # gangs, with the oracle's exact Statement semantics.
+        def inner_cond(s):
+            return (s["progress"] | ~s["tried_cons"]) \
+                & jnp.any(s["active"]) & (s["rounds"] < round_budget) \
+                & ~s["capped"]
+
+        if k_total > CHUNK:
+            # two sequential phases, not a per-round branch: live classes
+            # only ever shrink, so once the live set fits one sweep chunk
+            # every later round takes the compacted path. Sequential
+            # while_loops keep each body a straight-line program (a
+            # lax.cond here can lower to executing BOTH sweeps per round).
+            def live_over_chunk(s):
+                live = jnp.zeros(k_total, bool).at[
+                    enc["task_cls"]].max(s["active"])
+                return jnp.sum(live.astype(jnp.int32)) > chunk_k
+
+            st = lax.while_loop(
+                lambda s: inner_cond(s) & live_over_chunk(s),
+                round_body, st)
+            st = lax.while_loop(
+                inner_cond, functools.partial(round_body, compact=True), st)
+        else:
+            st = lax.while_loop(inner_cond, round_body, st)
+        st = lax.cond(
+            st["capped"],
+            lambda s: dict(s, dead=jnp.bool_(True)),
+            lambda s: rollback(s)[0],
+            st)
         return dict(st, tried_cons=jnp.bool_(False))
 
     st = lax.while_loop(outer_cond, outer_body, st)
@@ -662,6 +774,18 @@ def solve_rounds(spec: SolveSpec, enc: dict):
     # bind them (the apply path does not re-check job readiness)
     short = (enc["job_ready_base"] + st["job_placed"]) < enc["job_ready_threshold"]
     assign = jnp.where(short[task_job], -1, st["assign"])
+    # capped exit: mark the still-wanting tasks (stragglers + gangs the
+    # strip above just emptied) for the serial residue retry instead of a
+    # stale '0/N nodes' fit error — the solver folds -2 into residue
+    # accounting. Jobs retired by the rollback fixpoint (job_placed == 0,
+    # proven unplaceable) are NOT re-enqueued: dumping them on the serial
+    # pass would cost far more host work than the rounds the cap saved.
+    strip_retry = short & (st["job_placed"] > 0)
+    assign = jnp.where(
+        st["capped"]
+        & (st["active"] | (strip_retry[task_job] & task_valid))
+        & (assign < 0),
+        -2, assign)
     return assign, st["rounds"]
 
 

@@ -70,6 +70,8 @@ class AllocateAction(Action):
         for job in ssn.jobs.values():
             if job.pod_group.status.phase == objects.PodGroupPhase.PENDING:
                 continue
+            if not job.task_status_index.get(TaskStatus.PENDING):
+                continue  # nothing to place or pipeline for this job
             vr = ssn.job_valid(job)
             if vr is not None and not vr.pass_:
                 continue

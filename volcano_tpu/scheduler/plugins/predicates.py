@@ -266,14 +266,74 @@ class PredicatesPlugin(Plugin):
         # residue pass — so bypassing the event machinery cannot stale this
         # index.
         anti_resident: Dict[str, Tuple[objects.Pod, str]] = {}
+        # inverted symmetry index over the residents' required anti terms:
+        # a single-kv match_labels term excludes its node's topology domain
+        # for every incoming pod carrying that (scope-ns, k, v) label —
+        # sym_single[(ns, k, v)] refcounts {(topo_key, topo_val): n}.
+        # Terms the index cannot represent (multi-kv, match_expressions,
+        # selector-less) stay in sym_complex[uid] for the per-pod scan.
+        # Together they turn the per-incoming-pod symmetry sweep from
+        # O(residents) selector matches into O(pod labels) dict lookups.
+        sym_single: Dict[tuple, Dict[tuple, int]] = {}
+        sym_complex: Dict[str, list] = {}
+
+        def _sym_single_entries(pod: objects.Pod, node_name: str):
+            """((scope_ns, k, v), (topo_key, topo_val)) pairs for the
+            pod's index-representable terms — ONE classification shared by
+            add and remove so the refcounts always balance; terms it skips
+            are exactly the ones the caller routes to sym_complex."""
+            other = ssn.nodes.get(node_name)
+            for term in pod.spec.affinity.pod_anti_affinity.required_terms:
+                sel = term.label_selector
+                if other is not None and sel is not None \
+                        and not sel.match_expressions \
+                        and len(sel.match_labels) == 1:
+                    ((k, v),) = sel.match_labels.items()
+                    topo = (term.topology_key,
+                            _node_topology_value(other, term.topology_key))
+                    for scope_ns in (term.namespaces
+                                     or [pod.metadata.namespace]):
+                        yield (scope_ns, k, v), topo
+                else:
+                    yield None, term
+
+        def _anti_add(uid: str, pod: objects.Pod, node_name: str) -> None:
+            anti_resident[uid] = (pod, node_name)
+            for key, payload in _sym_single_entries(pod, node_name):
+                if key is not None:
+                    counts = sym_single.setdefault(key, {})
+                    counts[payload] = counts.get(payload, 0) + 1
+                else:
+                    sym_complex.setdefault(uid, []).append(
+                        (payload, pod.metadata.namespace, node_name))
+
+        def _anti_remove(uid: str) -> Optional[tuple]:
+            entry = anti_resident.pop(uid, None)
+            if entry is None:
+                return None
+            pod, node_name = entry
+            for key, payload in _sym_single_entries(pod, node_name):
+                if key is not None:
+                    counts = sym_single.get(key)
+                    if counts is not None:
+                        n = counts.get(payload, 0) - 1
+                        if n <= 0:
+                            counts.pop(payload, None)
+                        else:
+                            counts[payload] = n
+            sym_complex.pop(uid, None)
+            return entry
+
         for _node in all_nodes:
             for _t in _node.tasks.values():
                 if _has_required_anti_affinity(_t.pod):
-                    anti_resident[_t.uid] = (_t.pod, _node.name)
+                    _anti_add(_t.uid, _t.pod, _node.name)
 
         # generation counter for caches derived from anti_resident: bumped
         # on every mutation so per-pod symmetry sets recompute exactly when
-        # the resident picture changes mid-pass
+        # the resident picture changes mid-pass (the rebuild itself is
+        # cheap — the inverted sym_single index above absorbs the
+        # O(residents) work incrementally)
         anti_gen = [0]
 
         # per-node resident label-pair index: (uids, counts[(ns,k,v)],
@@ -369,7 +429,7 @@ class PredicatesPlugin(Plugin):
             if t.pod is not None and t.node_name:
                 _label_idx_add(t)
             if _has_required_anti_affinity(t.pod) and t.node_name:
-                anti_resident[t.uid] = (t.pod, t.node_name)
+                _anti_add(t.uid, t.pod, t.node_name)
                 anti_gen[0] += 1
 
         def _track_deallocate(event) -> None:
@@ -377,7 +437,7 @@ class PredicatesPlugin(Plugin):
             if t.pod is not None and t.status != TaskStatus.RELEASING:
                 _label_idx_remove(t)
             if _has_required_anti_affinity(t.pod) and t.status != TaskStatus.RELEASING:
-                if anti_resident.pop(t.uid, None) is not None:
+                if _anti_remove(t.uid) is not None:
                     anti_gen[0] += 1
 
         ssn.add_event_handler(EventHandler(_track_allocate, _track_deallocate))
@@ -405,16 +465,23 @@ class PredicatesPlugin(Plugin):
             hit = sym_cache.get(key)
             if hit is not None and hit[0] == anti_gen[0]:
                 return hit[1]
+            # single-kv terms via the inverted index: O(pod labels) lookups
             excluded = set()
-            for existing, node_name in anti_resident.values():
-                other = ssn.nodes.get(node_name)
-                if other is None:
-                    continue
-                for term in existing.spec.affinity.pod_anti_affinity.required_terms:
-                    if _selector_matches_pod(term, pod, existing.metadata.namespace):
-                        excluded.add((
-                            term.topology_key,
-                            _node_topology_value(other, term.topology_key)))
+            ns = pod.metadata.namespace
+            for k, v in pod.metadata.labels.items():
+                counts = sym_single.get((ns, k, v))
+                if counts:
+                    excluded.update(counts)
+            # the few complex-selector residents keep the per-pod scan
+            for entries in sym_complex.values():
+                for term, existing_ns, node_name in entries:
+                    if _selector_matches_pod(term, pod, existing_ns):
+                        other = ssn.nodes.get(node_name)
+                        if other is not None:
+                            excluded.add((
+                                term.topology_key,
+                                _node_topology_value(
+                                    other, term.topology_key)))
             if len(sym_cache) > 8192:
                 sym_cache.clear()
             sym_cache[key] = (anti_gen[0], excluded)
@@ -497,7 +564,7 @@ class PredicatesPlugin(Plugin):
             if t_pod := task.pod:
                 _label_idx_add(task)
                 if _has_required_anti_affinity(t_pod) and task.node_name:
-                    anti_resident[task.uid] = (t_pod, task.node_name)
+                    _anti_add(task.uid, t_pod, task.node_name)
                     anti_gen[0] += 1
 
         self.note_resident = note_resident

@@ -1,0 +1,128 @@
+"""The rounds solver's diminishing-returns exit (rounds.py capped path):
+capped leftovers are marked assign=-2, folded into residue accounting, and
+retried by the allocate action's serial residue pass the SAME session —
+complete outcomes, invariants intact, rollback-retired jobs not re-dumped.
+
+Also pins the keyed-binder pod contract both ways: a binder that declines
+pod objects (KEYED_NEEDS_PODS=False) gets pods=None; one that does not
+declare gets the full pods list aligned with keys/hosts.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import pytest
+
+from tests.helpers import close_session, open_session
+from volcano_tpu.scheduler.framework import get_action
+from volcano_tpu.scheduler.util.test_utils import FakeBinder
+
+
+def _run_cfg6(cache, tiers, actions):
+    ssn = open_session(cache, tiers)
+    assert ssn.batch_allocator is not None
+    ssn.batch_allocator.mode = "rounds"
+    for name in actions:
+        get_action(name).execute(ssn)
+    prof = dict(ssn.plugins["tpuscore"].profile)
+    close_session(ssn)
+    return prof
+
+
+class TestRoundCap:
+    def test_capped_leftovers_complete_via_serial_residue(self):
+        """At the affinity bench's shape the solve exits early (capped) and
+        the serial pass must finish the stragglers: full binds, residue
+        accounting consistent, anti-affinity exclusion intact."""
+        from volcano_tpu.bench.clusters import build_config
+
+        cache, _, tiers, actions, n = build_config(6, 0.4)
+        prof = _run_cfg6(cache, tiers, actions)
+        assert prof.get("mode") == "rounds"
+        capped = prof.get("round_capped_tasks", 0)
+        assert capped > 0, "expected the diminishing-returns exit to fire"
+        # capped tasks are counted as residue so allocate runs the serial
+        # pass; the session outcome must still be COMPLETE
+        assert prof.get("residue", 0) >= capped
+        assert len(cache.binder.binds) == n
+        # required anti-affinity: no two same-app pods share a node
+        app_nodes = defaultdict(lambda: defaultdict(int))
+        for job in cache.jobs.values():
+            for t in job.tasks.values():
+                pod = t.pod
+                if pod is not None and "app" in pod.metadata.labels \
+                        and t.node_name:
+                    app_nodes[pod.metadata.labels["app"]][t.node_name] += 1
+        violations = [
+            (app, node, c)
+            for app, m in app_nodes.items()
+            for node, c in m.items() if c > 1
+        ]
+        assert not violations, violations[:3]
+
+    def test_capped_run_matches_uncapped_outcome(self):
+        """Disabling the cap (min_progress=0) must place the same pod SET —
+        only WHICH engine (device round vs serial pass) places the tail
+        may differ."""
+        from volcano_tpu.bench.clusters import build_config
+
+        cache, _, tiers, actions, n = build_config(6, 0.3)
+        _run_cfg6(cache, tiers, actions)
+        capped_binds = dict(cache.binder.binds)
+
+        # faithful no-cap twin: neutralize the floor the solver stamps
+        src_attr = "round_min_progress"
+        from volcano_tpu.ops.kernels import SolveSpec
+
+        orig_replace = SolveSpec._replace
+
+        def patched_replace(spec, **kw):
+            kw[src_attr] = 0
+            return orig_replace(spec, **kw)
+
+        cache2, _, tiers2, actions2, n2 = build_config(6, 0.3)
+        SolveSpec._replace = patched_replace
+        try:
+            _run_cfg6(cache2, tiers2, actions2)
+        finally:
+            SolveSpec._replace = orig_replace
+        assert set(capped_binds) == set(cache2.binder.binds)
+        assert len(capped_binds) == n
+
+
+class TestKeyedBinderPodContract:
+    @pytest.mark.parametrize("needs_pods", [False, True])
+    def test_keyed_binder_pod_delivery(self, needs_pods):
+        """want_pods routing: a binder that declines pods gets pods=None;
+        a declaring-nothing binder gets the aligned pods list (the default
+        production path through fastapply's pod-extraction branch)."""
+        from volcano_tpu.bench.clusters import build_config
+
+        seen = {}
+
+        class RecordingBinder(FakeBinder):
+            def bind_many_keyed(self, keys, pods, hosts):
+                seen["pods"] = pods
+                seen["keys"] = list(keys)
+                super().bind_many_keyed(keys, pods, hosts)
+
+        if needs_pods:
+            RecordingBinder.KEYED_NEEDS_PODS = True
+
+        cache, _, tiers, actions, n = build_config(2, 0.5)
+        cache.binder = RecordingBinder()
+        ssn = open_session(cache, tiers)
+        ssn.batch_allocator.mode = "rounds"
+        for name in actions:
+            get_action(name).execute(ssn)
+        close_session(ssn)
+        assert len(cache.binder.binds) == n
+        assert len(seen["keys"]) == n
+        if needs_pods:
+            assert seen["pods"] is not None and len(seen["pods"]) == n
+            # pods aligned with keys
+            for key, pod in zip(seen["keys"][:50], seen["pods"][:50]):
+                assert key == f"{pod.metadata.namespace}/{pod.metadata.name}"
+        else:
+            assert seen["pods"] is None

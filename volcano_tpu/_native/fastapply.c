@@ -310,13 +310,19 @@ apply_all_jobs(PyObject *self, PyObject *args)
     PyObject *job_infos, *cache_jobs, *pending, *binding;
     PyObject *job_sums_o, *scalar_names;
     PyObject *bind_tasks, *bind_pods, *bind_hosts, *bind_keys;
+    /* want_pods=0 skips the per-task .pod extraction into bind_pods — a
+     * keyed binder that does not consume pod objects (the k8s Bind
+     * subresource needs only name + target) saves one getattr + append
+     * per placement */
+    int want_pods = 1;
 
-    if (!PyArg_ParseTuple(args, "OOOOOOOOOOOOOOOOOO",
+    if (!PyArg_ParseTuple(args, "OOOOOOOOOOOOOOOOOO|i",
                           &job_nz_o, &seg_ends_o, &placed_o, &assign_o,
                           &task_infos, &node_names, &ssn_nodes, &cache_nodes,
                           &job_infos, &cache_jobs, &pending, &binding,
                           &job_sums_o, &scalar_names,
-                          &bind_tasks, &bind_pods, &bind_hosts, &bind_keys))
+                          &bind_tasks, &bind_pods, &bind_hosts, &bind_keys,
+                          &want_pods))
         return NULL;
 
     int have_cache_nodes = cache_nodes != Py_None;
@@ -580,7 +586,7 @@ apply_all_jobs(PyObject *self, PyObject *args)
 
             if (PyList_Append(bind_tasks, task) < 0)
                 goto task_fail;
-            {
+            if (want_pods) {
                 PyObject *pod = PyObject_GetAttr(task, s_pod);
                 if (pod == NULL)
                     goto task_fail;

@@ -538,6 +538,14 @@ class BatchAllocator:
         mod = get_fastapply_nowait()
         fast_all = getattr(mod, "apply_all_jobs", None) \
             if (mod is not None and vols_noop) else None
+        # a keyed binder that declares it does not consume pod objects
+        # (KEYED_NEEDS_PODS = False — the k8s Bind subresource needs only
+        # name + target) lets the writeback skip 50k .pod extractions;
+        # the BindManyError retry path still reads task.pod lazily
+        binder0 = cache.binder
+        want_pods = not (
+            getattr(binder0, "bind_many_keyed", None) is not None
+            and getattr(binder0, "KEYED_NEEDS_PODS", True) is False)
         try:
             if fast_all is not None:
                 fast_all(
@@ -547,7 +555,8 @@ class BatchAllocator:
                     job_infos, cache.jobs, PENDING, BINDING,
                     np.ascontiguousarray(job_sums),
                     tuple(scalar_names),
-                    bind_tasks, bind_pods, bind_hosts, bind_keys)
+                    bind_tasks, bind_pods, bind_hosts, bind_keys,
+                    int(want_pods))
                 loop_jobs = ()  # the batched call covered every job
             else:
                 loop_jobs = job_nz
@@ -639,7 +648,8 @@ class BatchAllocator:
                         alloc_vols(task, host)
                         bind_vols(task)
                     bind_tasks.append(task)
-                    bind_pods.append(task.pod)
+                    if want_pods:
+                        bind_pods.append(task.pod)
                     bind_hosts.append(host)
                     bind_keys.append(key)
 
@@ -662,9 +672,11 @@ class BatchAllocator:
         keyed_bind = getattr(binder, "bind_many_keyed", None)
         if keyed_bind is not None:
             # the apply loop already derived each placement's ns/name key;
-            # a keyed binder skips 50k metadata re-derivations
+            # a keyed binder skips 50k metadata re-derivations (pods is
+            # None when the binder declared KEYED_NEEDS_PODS = False)
             try:
-                keyed_bind(bind_keys, bind_pods, bind_hosts)
+                keyed_bind(bind_keys, bind_pods if want_pods else None,
+                           bind_hosts)
             except BindManyError as e:
                 retry_from = e.done
             except Exception:

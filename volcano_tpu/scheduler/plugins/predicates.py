@@ -298,6 +298,8 @@ class PredicatesPlugin(Plugin):
                     yield None, term
 
         def _anti_add(uid: str, pod: objects.Pod, node_name: str) -> None:
+            if uid in anti_resident:
+                return  # idempotent (unevict re-fires allocate)
             anti_resident[uid] = (pod, node_name)
             for key, payload in _sym_single_entries(pod, node_name):
                 if key is not None:

@@ -135,9 +135,15 @@ class FakeBinder:
             self.channel.extend(k for k, _ in keyed)
             self._cond.notify_all()
 
+    # the keyed path needs no pod objects (the k8s Bind subresource binds
+    # by name + target); the bulk writeback then skips per-task .pod
+    # extraction entirely and passes pods=None
+    KEYED_NEEDS_PODS = False
+
     def bind_many_keyed(self, keys, pods, hosts) -> None:
         """Batch bind with caller-derived ns/name keys (the bulk-apply
-        writeback already built them); skips 50k metadata re-derivations."""
+        writeback already built them); skips 50k metadata re-derivations.
+        ``pods`` may be None (see KEYED_NEEDS_PODS)."""
         with self._cond:
             self.binds.update(zip(keys, hosts))
             self.channel.extend(keys)

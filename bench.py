@@ -132,6 +132,12 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
             del cache
             gc.collect()
             cache, _, tpu_tiers, actions, n_tasks = build_config(cfg, scale)
+            # building the cluster allocates heavily; collect that debt
+            # BEFORE the timed window so a generational collection isn't
+            # charged to whichever session phase it randomly lands in (the
+            # production loop schedules between-cycle collections the same
+            # way — utils/gcpolicy.py)
+            gc.collect()
             w = _session_once(cache, tpu_tiers, actions, mesh=mesh)
             samples.append(w["actions_s"] * 1e3)
             warm_compiles.append(w["profile"].get("compiles", 0))

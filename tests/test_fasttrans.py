@@ -1,0 +1,138 @@
+"""Native transition engine (_native/fasttrans.c via ops/fasttrans.py):
+exact end-state equivalence with the Python Statement/Session/cache oracle
+across the preempt/reclaim/backfill pipeline, including discard unwinds.
+
+The comparison is deliberately total: bindings, evictions, job status
+buckets AND version counters, node accounting AND generation counters,
+DRF job/namespace shares, proportion queue shares, and the cache mirror —
+a fused transition that diverges anywhere shows up here.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sysconfig
+
+import pytest
+
+import volcano_tpu._native as native
+import volcano_tpu.scheduler.actions  # noqa: F401
+from volcano_tpu.bench.clusters import build_config
+from volcano_tpu.scheduler.framework import close_session, get_action, open_session
+
+
+def _toolchain():
+    cc = (sysconfig.get_config_var("CC") or "cc").split()[0]
+    return shutil.which(cc) is not None
+
+
+def _res_tuple(r):
+    return (r.milli_cpu, r.memory,
+            {k: v for k, v in (r.scalar_resources or {}).items() if v})
+
+
+def _run(cfg: int, scale: float, no_native: bool):
+    if no_native:
+        os.environ["VOLCANO_TPU_NO_NATIVE"] = "1"
+    else:
+        os.environ.pop("VOLCANO_TPU_NO_NATIVE", None)
+    native._reset()
+    if not no_native and native.get_fasttrans() is None:
+        pytest.skip("native module unavailable; fallback covered elsewhere")
+    try:
+        cache, _, tiers, actions, _ = build_config(cfg, scale)
+        ssn = open_session(cache, tiers)
+        for name in actions:
+            get_action(name).execute(ssn)
+        used_ft = ssn.fast_trans() is not None
+        assert used_ft is (not no_native), \
+            "fast path must be exercised exactly when native is enabled"
+        jobs = {
+            uid: {
+                "alloc": _res_tuple(j.allocated),
+                "buckets": {int(k): sorted(v)
+                            for k, v in j.task_status_index.items()},
+                "ver": j._status_version,
+                "tasks": {tuid: (int(t.status), t.node_name)
+                          for tuid, t in j.tasks.items()},
+            }
+            for uid, j in ssn.jobs.items()
+        }
+        nodes = {
+            name: {
+                "idle": _res_tuple(nd.idle),
+                "used": _res_tuple(nd.used),
+                "rel": _res_tuple(nd.releasing),
+                "gen": nd._acct_gen,
+                "tasks": {k: int(t.status) for k, t in nd.tasks.items()},
+                "phase": int(nd.state.phase),
+            }
+            for name, nd in ssn.nodes.items()
+        }
+        drf = ssn.plugins.get("drf")
+        drf_state = ({uid: (a.share, a.dominant_resource,
+                            _res_tuple(a.allocated))
+                      for uid, a in drf.job_attrs.items()} if drf else None)
+        drf_ns = ({ns: (a.share, _res_tuple(a.allocated))
+                   for ns, a in drf.namespace_opts.items()} if drf else None)
+        prop = ssn.plugins.get("proportion")
+        prop_state = ({q: (a.share, _res_tuple(a.allocated))
+                       for q, a in prop.queue_opts.items()} if prop else None)
+        close_session(ssn)
+        ev = getattr(cache.evictor, "evictions", None)
+        if ev is None:
+            ev = getattr(cache.evictor, "evicts", [])
+        cache_tasks = {
+            uid: {tuid: (int(t.status), t.node_name)
+                  for tuid, t in j.tasks.items()}
+            for uid, j in cache.jobs.items()
+        }
+        return {
+            "binds": dict(cache.binder.binds),
+            "evicts": sorted(map(str, ev)),
+            "jobs": jobs, "nodes": nodes, "drf": drf_state,
+            "drf_ns": drf_ns, "prop": prop_state, "cache": cache_tasks,
+        }
+    finally:
+        os.environ.pop("VOLCANO_TPU_NO_NATIVE", None)
+        native._reset()
+
+
+def test_shared_dense_view_invalidated_by_untracked_placements():
+    """The session-cached dense view must rebuild when a placement bypassed
+    its hooks (e.g. a conf ordering the allocate action between the
+    view-sharing actions) — a stale view would serve outdated pod-count/
+    used state to backfill/preempt/reclaim."""
+    from volcano_tpu.ops import preemptview
+
+    cache, _, tiers, actions, _ = build_config(4, 0.05)
+    ssn = open_session(cache, tiers)
+    try:
+        v1 = preemptview.build(ssn)
+        assert v1 is not None
+        assert preemptview.build(ssn) is v1, "hook-synced view must be shared"
+        # a placement the view was not notified of (bulk apply, custom action)
+        ssn._placement_gen += 1
+        v2 = preemptview.build(ssn)
+        assert v2 is not None and v2 is not v1, \
+            "untracked placement must force a rebuild"
+        # hook-notified placements keep the view shared
+        ssn._placement_gen += 1
+        v2.on_pipeline(next(iter(ssn.nodes)), next(
+            t for j in ssn.jobs.values() for t in j.tasks.values()))
+        assert preemptview.build(ssn) is v2
+    finally:
+        close_session(ssn)
+
+
+@pytest.mark.skipif(not _toolchain(), reason="no C toolchain")
+@pytest.mark.parametrize("cfg,scale", [(4, 0.12), (2, 0.15), (6, 0.15)])
+def test_native_transitions_equal_python_oracle(cfg, scale):
+    nat = _run(cfg, scale, no_native=False)
+    py = _run(cfg, scale, no_native=True)
+    for key in py:
+        assert nat[key] == py[key], f"{key} diverges between native and oracle"
+    if cfg == 4:
+        assert len(nat["evicts"]) > 0, "overcommit config must exercise evict"
+    assert len(nat["binds"]) > 0

@@ -21,8 +21,7 @@ def _run_cfg5(no_native: bool):
     # reset the once-per-process memo so the env var takes effect
     import volcano_tpu._native as native
 
-    native._TRIED = False
-    native._FASTAPPLY = None
+    native._reset()
     if not no_native:
         # block on the build so the native path is genuinely exercised
         # (the solver's nowait call would otherwise fall back this session)
@@ -53,8 +52,7 @@ def _run_cfg5(no_native: bool):
         return binds, node_state, statuses, ssn_statuses
     finally:
         os.environ.pop("VOLCANO_TPU_NO_NATIVE", None)
-        native._TRIED = False
-        native._FASTAPPLY = None
+        native._reset()
 
 
 class TestNativeFastApply:
@@ -67,8 +65,7 @@ class TestNativeFastApply:
         cc = (sysconfig.get_config_var("CC") or "cc").split()[0]
         if shutil.which(cc) is None:
             pytest.skip(f"no C toolchain ({cc}); Python fallback covers this")
-        native._TRIED = False
-        native._FASTAPPLY = None
+        native._reset()
         mod = native.get_fastapply()
         assert mod is not None, "toolchain present; native module must build"
         assert hasattr(mod, "apply_job_tasks")
@@ -88,8 +85,7 @@ class TestNativeFastApply:
         import volcano_tpu._native as native
 
         monkeypatch.setenv("VOLCANO_TPU_NO_NATIVE", "1")
-        native._TRIED = False
-        native._FASTAPPLY = None
+        native._reset()
         assert native.get_fastapply() is None
-        native._TRIED = False
-        native._FASTAPPLY = None
+        assert native.get_fasttrans() is None
+        native._reset()

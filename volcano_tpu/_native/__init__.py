@@ -1,11 +1,12 @@
 """Native runtime components, compiled lazily at first use.
 
 The control plane is Python with the solve on TPU; the few remaining
-interpreted hot loops (the bulk-apply writeback) have native equivalents
-here, compiled on demand with the system toolchain into this package
-directory and imported like any extension module. Every native path has a
-pure-Python fallback — a missing compiler, failed build, or failed import
-degrades to the oracle implementation, never to an error.
+interpreted hot loops (the bulk-apply writeback, the per-operation
+preempt/reclaim transitions) have native equivalents here, compiled on
+demand with the system toolchain into this package directory and imported
+like any extension module. Every native path has a pure-Python fallback — a
+missing compiler, failed build, or failed import degrades to the oracle
+implementation, never to an error.
 """
 
 from __future__ import annotations
@@ -20,9 +21,24 @@ import sysconfig
 logger = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_FASTAPPLY = None
-_TRIED = False
-_BUILD_THREAD = None
+# per-module load state: name -> {"mod": module|None, "tried": bool,
+# "done": bool, "thread": Thread|None}. "tried" gates re-attempts;
+# "done" means the attempt fully finished (build+import) — the two differ
+# while a build is in flight.
+_STATE: dict = {}
+# per-module build locks, deliberately OUTSIDE _STATE: _reset() must not
+# clear them, or a reset mid-compile would let a second cc race the first
+# on the shared .so.tmp output
+_LOCKS: dict = {}
+
+
+def _lock(modname: str):
+    import threading
+
+    lk = _LOCKS.get(modname)
+    if lk is None:
+        lk = _LOCKS.setdefault(modname, threading.Lock())
+    return lk
 
 
 def _paths(src: str, modname: str):
@@ -56,43 +72,85 @@ def _build(src: str, modname: str) -> bool:
     return True
 
 
-def get_fastapply():
-    """The compiled _fastapply module, or None (callers keep the Python
-    loop). Build+import attempted once per process. BLOCKS on the compiler
-    the first time — latency-critical callers use get_fastapply_nowait."""
-    global _FASTAPPLY, _TRIED
-    if _TRIED:
-        return _FASTAPPLY
-    _TRIED = True
+def _get(src: str, modname: str):
+    """The compiled module, or None (callers keep the Python loop).
+    Build+import attempted once per process per module. BLOCKS on the
+    compiler the first time — latency-critical callers use _get_nowait.
+    The per-module lock serializes a blocking call racing the background
+    thread (only one cc ever writes the .so.tmp)."""
+    with _lock(modname):
+        st = _STATE.setdefault(
+            modname, {"mod": None, "tried": False, "done": False, "thread": None})
+        if st["tried"]:
+            return st["mod"]
+        st["tried"] = True
+        try:
+            if os.environ.get("VOLCANO_TPU_NO_NATIVE"):
+                return None
+            try:
+                if _build(src, modname):
+                    if _DIR not in sys.path:
+                        sys.path.insert(0, _DIR)
+                    st["mod"] = importlib.import_module(modname)
+            except Exception:
+                logger.exception(
+                    "native %s unavailable; using Python fallback", modname)
+                st["mod"] = None
+        finally:
+            st["done"] = True
+        return st["mod"]
+
+
+def _get_nowait(src: str, modname: str):
+    """Non-blocking variant for critical paths: returns the module if it is
+    already available (cached .so imports in milliseconds), else kicks the
+    compile off on a background thread ONCE and returns None — the first
+    session runs the Python fallback instead of waiting on cc."""
+    st = _STATE.setdefault(
+        modname, {"mod": None, "tried": False, "done": False, "thread": None})
+    if st["done"]:
+        return st["mod"]
     if os.environ.get("VOLCANO_TPU_NO_NATIVE"):
         return None
-    try:
-        if _build("fastapply.c", "_fastapply"):
-            if _DIR not in sys.path:
-                sys.path.insert(0, _DIR)
-            _FASTAPPLY = importlib.import_module("_fastapply")
-    except Exception:
-        logger.exception("native fastapply unavailable; using Python fallback")
-        _FASTAPPLY = None
-    return _FASTAPPLY
+    src_path, out = _paths(src, modname)
+    if _is_fresh(src_path, out):
+        return _get(src, modname)  # import only — no compiler run
+    if st["thread"] is None:
+        import threading
+
+        st["thread"] = threading.Thread(
+            target=_get, args=(src, modname), daemon=True)
+        st["thread"].start()
+    return None
+
+
+def _reset() -> None:
+    """Forget load state so the next get_* re-evaluates the env gate and
+    build (tests poke this; the .so cache on disk is untouched). The build
+    locks survive, so a reset cannot let two compiles race."""
+    _STATE.clear()
+
+
+def settled(modname: str) -> bool:
+    """True once a load attempt for `modname` fully finished (module built,
+    failed, or env-disabled); False while a build is still in flight."""
+    if os.environ.get("VOLCANO_TPU_NO_NATIVE"):
+        return True
+    st = _STATE.get(modname)
+    return bool(st and st["done"])
+
+
+def get_fastapply():
+    return _get("fastapply.c", "_fastapply")
 
 
 def get_fastapply_nowait():
-    """Non-blocking variant for the apply critical path: returns the module
-    if it is already available (cached .so imports in milliseconds), else
-    kicks the compile off on a background thread ONCE and returns None —
-    the first session runs the Python fallback instead of waiting on cc."""
-    global _BUILD_THREAD
-    if _TRIED:
-        return _FASTAPPLY
-    if os.environ.get("VOLCANO_TPU_NO_NATIVE"):
-        return None
-    src_path, out = _paths("fastapply.c", "_fastapply")
-    if _is_fresh(src_path, out):
-        return get_fastapply()  # import only — no compiler run
-    if _BUILD_THREAD is None:
-        import threading
+    return _get_nowait("fastapply.c", "_fastapply")
 
-        _BUILD_THREAD = threading.Thread(target=get_fastapply, daemon=True)
-        _BUILD_THREAD.start()
-    return None
+
+def get_fasttrans():
+    return _get("fasttrans.c", "_fasttrans")
+
+
+def get_fasttrans_nowait():
+    return _get_nowait("fasttrans.c", "_fasttrans")

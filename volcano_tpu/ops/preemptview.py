@@ -45,13 +45,30 @@ MAX_PRIORITY = nodeorder_mod.MAX_PRIORITY
 
 def build(ssn) -> Optional["DensePreemptView"]:
     """A view over the session, or None when the session uses constructs the
-    dense rows cannot model (the caller then runs fully serial)."""
+    dense rows cannot model (the caller then runs fully serial).
+
+    The view is built ONCE per session and shared by backfill/preempt/
+    reclaim: every mutation those actions perform is routed through the
+    view's on_(un)pipeline hooks, so the shared instance tracks exactly the
+    state a fresh build would capture — and its per-class score/eligibility
+    caches stay warm across the actions. (The allocate-residue variant
+    below tracks extra state and is NOT shared.)"""
     if getattr(ssn, "batch_allocator", None) is None:
         return None  # tpuscore off => bit-identical serial behavior
+    cached = getattr(ssn, "_dense_preempt_view", False)
+    if cached is not False:
+        # a placement the view was not notified of (another action ran in
+        # between — e.g. a conf ordering allocate after preempt) makes the
+        # cached used/pod-count state stale: rebuild. Unsupported (None)
+        # stays unsupported — residents only accumulate within a session.
+        if cached is None or cached._synced_gen == ssn._placement_gen:
+            return cached
     try:
-        return DensePreemptView(ssn)
+        view = DensePreemptView(ssn)
     except _Unsupported:
-        return None
+        view = None
+    ssn._dense_preempt_view = view
+    return view
 
 
 def build_alloc_assist(ssn) -> Optional["DensePreemptView"]:
@@ -206,6 +223,11 @@ class DensePreemptView:
                     elif rn in w.binpacking_resources:
                         self.binpack_w[ri] = w.binpacking_resources[rn]
 
+        # session placement generation this view is synced to: captured at
+        # build, advanced by each hook notification. build() compares it
+        # to ssn._placement_gen — equality proves every placement-shaped
+        # mutation since build was routed through the hooks
+        self._synced_gen = getattr(ssn, "_placement_gen", 0)
         self._sig_mask: Dict[str, np.ndarray] = {}
         self._sig_aff: Dict[str, Optional[np.ndarray]] = {}
         self._node_idx = {name: i for i, name in enumerate(self.node_names)}
@@ -509,8 +531,21 @@ class DensePreemptView:
         if sel.size == 0:
             return iter(())
         scores = self._score_row(task, aff, sel)
-        order = np.argsort(-scores, kind="stable")
-        return map(self.nodes.__getitem__, sel[order])
+        nodes = self.nodes
+
+        def _stream():
+            # consumers almost always stop at the first workable node, so
+            # the head comes from argmax (first occurrence of the max ==
+            # head of the stable descending sort) and the full sort is paid
+            # only if the consumer keeps going
+            first = int(np.argmax(scores))
+            yield nodes[int(sel[first])]
+            order = np.argsort(-scores, kind="stable")
+            for p in order.tolist():
+                if p != first:
+                    yield nodes[int(sel[p])]
+
+        return _stream()
 
     def masked_nodes_in_name_order(self, task):
         """Reclaim/backfill candidate stream: feasible nodes in name order
@@ -526,6 +561,7 @@ class DensePreemptView:
     # -- state updates (pipeline is the only op that moves `used`/cnt) -----
 
     def _node_delta(self, node_name: str, task, sign: int) -> None:
+        self._synced_gen += 1
         i = self._node_idx.get(node_name)
         if i is None:
             return
@@ -632,6 +668,7 @@ class DensePreemptView:
 
     def _alloc_delta(self, node_name: str, task, sign: int,
                      pipelined: bool) -> None:
+        self._synced_gen += 1
         i = self._node_idx.get(node_name)
         if i is None:
             return

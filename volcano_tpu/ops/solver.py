@@ -438,6 +438,10 @@ class BatchAllocator:
         shares) but with all resource accounting vectorized and the
         remaining per-task work reduced to attribute writes + dict moves.
 
+        Bumps the session placement generation: these writes bypass the
+        Session/Statement mutators, so any cached dense view must rebuild
+        (preemptview.build's generation gate).
+
         The statement path costs ~40us/task in event handlers, epsilon
         asserts, and per-task Resource arithmetic; at 50k tasks that is the
         session bottleneck, not the device solve. Here each placement costs
@@ -449,6 +453,7 @@ class BatchAllocator:
         from volcano_tpu.api.unschedule_info import FitErrors
         from volcano_tpu.scheduler.cache.interface import BindManyError
 
+        ssn._placement_gen += 1
         prof_t0 = time.perf_counter()
         a = enc.arrays
         t_real = len(enc.task_infos)

@@ -148,6 +148,11 @@ class SchedulerCache:
         self._lock = threading.RLock()
         self._err_tasks: List[TaskInfo] = []
         self._deleted_jobs: List[JobInfo] = []
+        # native mirror-transition ctx for the effector path (built lazily;
+        # False = not attempted, None = unavailable). jobs/nodes dict
+        # objects are created once above and never reassigned, so the ctx
+        # stays valid for the cache's lifetime.
+        self._fast_mirror = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -391,18 +396,36 @@ class SchedulerCache:
             raise KeyError(f"failed to find task in status {task_info.status} by id {task_info.uid}")
         return job, task
 
+    def _mirror(self):
+        """Native effector-side transition ctx, or None (Python path). A
+        None while the background native compile is still in flight is NOT
+        latched — the cache outlives sessions, so giving up on the first
+        cold-start call would disable the native path for its lifetime."""
+        if self._fast_mirror is False:
+            from volcano_tpu.ops import fasttrans
+
+            m = fasttrans.build_mirror(self.jobs, self.nodes)
+            if m is None and not fasttrans.native_settled():
+                return None  # retry on a later effector call
+            self._fast_mirror = m
+        return self._fast_mirror
+
     def bind(self, task_info: TaskInfo, hostname: str) -> None:
         """Update cache state to Binding and invoke the binder; on binder
         failure, queue the task for resync (cache.go:558-613)."""
+        mirror = self._mirror()
         with self._lock:
-            job, task = self._find_job_and_task(task_info)
-            node = self.nodes.get(hostname)
-            if node is None:
-                raise KeyError(f"failed to bind Task {task.uid} to host {hostname}: host does not exist")
-            job.update_task_status(task, TaskStatus.BINDING)
-            task.node_name = hostname
-            node.add_task(task)
-            pod = task.pod
+            if mirror is not None:
+                task, pod = mirror.mirror_bind(task_info, hostname)
+            else:
+                job, task = self._find_job_and_task(task_info)
+                node = self.nodes.get(hostname)
+                if node is None:
+                    raise KeyError(f"failed to bind Task {task.uid} to host {hostname}: host does not exist")
+                job.update_task_status(task, TaskStatus.BINDING)
+                task.node_name = hostname
+                node.add_task(task)
+                pod = task.pod
         try:
             self.binder.bind(pod, hostname)
         except Exception:
@@ -415,14 +438,18 @@ class SchedulerCache:
                 )
 
     def evict(self, task_info: TaskInfo, reason: str) -> None:
+        mirror = self._mirror()
         with self._lock:
-            job, task = self._find_job_and_task(task_info)
-            node = self.nodes.get(task.node_name)
-            if node is None:
-                raise KeyError(f"failed to evict Task {task.uid}: host {task.node_name} does not exist")
-            job.update_task_status(task, TaskStatus.RELEASING)
-            node.update_task(task)
-            pod = task.pod
+            if mirror is not None:
+                task, pod = mirror.mirror_evict(task_info)
+            else:
+                job, task = self._find_job_and_task(task_info)
+                node = self.nodes.get(task.node_name)
+                if node is None:
+                    raise KeyError(f"failed to evict Task {task.uid}: host {task.node_name} does not exist")
+                job.update_task_status(task, TaskStatus.RELEASING)
+                node.update_task(task)
+                pod = task.pod
         try:
             self.evictor.evict(pod, reason)
         except Exception:

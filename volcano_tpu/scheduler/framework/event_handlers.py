@@ -19,3 +19,7 @@ class Event:
 class EventHandler:
     allocate_func: Optional[Callable[[Event], None]] = None
     deallocate_func: Optional[Callable[[Event], None]] = None
+    # stock plugins tag their handlers so the native transition engine
+    # (ops/fasttrans.py) can recognize — and fuse — exactly the handler
+    # set it models; any untagged handler disables the fast path
+    origin: Optional[tuple] = None

@@ -66,6 +66,13 @@ class Session:
         self._flat_fns_cache: Dict[tuple, List[Callable]] = {}
         self._stock_task_key_memo = None
         self._node_order_pairs_cache = None
+        self._fast_trans = False  # False = not built yet (None = unavailable)
+        self._job_valid_memo = None  # None = gate undecided; False = off
+        # bumped by every placement-shaped node mutation (allocate/pipeline
+        # and their unwinds, plus the bulk writeback). The shared dense
+        # preempt view validates against it: a view that missed a mutation
+        # rebuilds instead of serving stale used/pod-count state
+        self._placement_gen = 0
 
     # ------------------------------------------------------------------
     # registration (session_plugins.go:26-104)
@@ -209,12 +216,32 @@ class Session:
         return True
 
     def job_valid(self, job: JobInfo):
+        # preempt/reclaim/backfill each dispatch this once per job; when
+        # every registered validator declares itself a pure function of the
+        # job's status index (the stock gang one does), the verdict is
+        # memoized per (job, _status_version)
+        memo = self._job_valid_memo
+        if memo is None:
+            memo = self._job_valid_memo = (
+                {} if all(getattr(fn, "_status_version_keyed", False)
+                          for fn in self.job_valid_fns.values()) else False)
+        if memo is not False:
+            key = job.uid
+            hit = memo.get(key)
+            if hit is not None and hit[0] == job._status_version:
+                return hit[1]
+        vr_out = None
         for tier_fns in self._tier_plugins(None, self.job_valid_fns):
             for fn in tier_fns:
                 vr = fn(job)
                 if vr is not None and not vr.pass_:
-                    return vr
-        return None
+                    vr_out = vr
+                    break
+            if vr_out is not None:
+                break
+        if memo is not False:
+            memo[job.uid] = (job._status_version, vr_out)
+        return vr_out
 
     def job_enqueueable(self, job: JobInfo) -> bool:
         for tier_fns in self._tier_plugins(None, self.job_enqueueable_fns):
@@ -390,6 +417,16 @@ class Session:
 
         return Statement(self)
 
+    def fast_trans(self):
+        """The session's native transition engine (ops/fasttrans.py), or
+        None when the handler set is not the recognized stock set. Built
+        once, after plugins have registered (actions run later)."""
+        if self._fast_trans is False:
+            from volcano_tpu.ops import fasttrans
+
+            self._fast_trans = fasttrans.build(self)
+        return self._fast_trans
+
     def _fire_allocate(self, task: TaskInfo) -> None:
         for eh in self.event_handlers:
             if eh.allocate_func is not None:
@@ -402,6 +439,11 @@ class Session:
 
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
         """Place onto releasing resources; session-state only (session.go:205-245)."""
+        self._placement_gen += 1
+        ft = self.fast_trans()
+        if ft is not None:
+            ft.pipeline(task, hostname, strict=True)
+            return
         job = self.jobs.get(task.job)
         if job is None:
             raise KeyError(f"failed to find job {task.job} when pipelining")
@@ -417,16 +459,21 @@ class Session:
         """Allocate onto idle resources; dispatches the whole job when it
         becomes gang-ready (session.go:248-303)."""
         self.cache.allocate_volumes(task, hostname)
-        job = self.jobs.get(task.job)
-        if job is None:
-            raise KeyError(f"failed to find job {task.job}")
-        job.update_task_status(task, TaskStatus.ALLOCATED)
-        task.node_name = hostname
-        node = self.nodes.get(hostname)
-        if node is None:
-            raise KeyError(f"failed to find node {hostname}")
-        node.add_task(task)
-        self._fire_allocate(task)
+        self._placement_gen += 1
+        ft = self.fast_trans()
+        if ft is not None:
+            job = ft.allocate(task, hostname)
+        else:
+            job = self.jobs.get(task.job)
+            if job is None:
+                raise KeyError(f"failed to find job {task.job}")
+            job.update_task_status(task, TaskStatus.ALLOCATED)
+            task.node_name = hostname
+            node = self.nodes.get(hostname)
+            if node is None:
+                raise KeyError(f"failed to find node {hostname}")
+            node.add_task(task)
+            self._fire_allocate(task)
 
         if self.job_ready(job):
             for t in list(job.task_status_index.get(TaskStatus.ALLOCATED, {}).values()):
@@ -444,6 +491,10 @@ class Session:
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """(session.go:332-369)"""
         self.cache.evict(reclaimee, reason)
+        ft = self.fast_trans()
+        if ft is not None:
+            ft.evict(reclaimee, strict=True)
+            return
         job = self.jobs.get(reclaimee.job)
         if job is None:
             raise KeyError(f"failed to find job {reclaimee.job}")

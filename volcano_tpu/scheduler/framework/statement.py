@@ -23,11 +23,18 @@ class Statement:
     def __init__(self, ssn):
         self.ssn = ssn
         self.operations: List[Tuple[str, tuple]] = []
+        # native transition engine (None => every op runs the Python body
+        # below, which remains the behavioral oracle)
+        self._ft = ssn.fast_trans()
 
     # -- evict -------------------------------------------------------------
 
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """Session-state eviction, logged (statement.go:40-72)."""
+        if self._ft is not None:
+            self._ft.evict(reclaimee, strict=False)
+            self.operations.append(("evict", (reclaimee, reason)))
+            return
         ssn = self.ssn
         job = ssn.jobs.get(reclaimee.job)
         if job is not None:
@@ -46,6 +53,9 @@ class Statement:
             self._unevict(reclaimee)
 
     def _unevict(self, reclaimee: TaskInfo) -> None:
+        if self._ft is not None:
+            self._ft.unevict(reclaimee)
+            return
         ssn = self.ssn
         job = ssn.jobs.get(reclaimee.job)
         if job is not None:
@@ -63,6 +73,11 @@ class Statement:
 
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
         """(statement.go:116-156)"""
+        self.ssn._placement_gen += 1
+        if self._ft is not None:
+            self._ft.pipeline(task, hostname, strict=False)
+            self.operations.append(("pipeline", (task, hostname)))
+            return
         ssn = self.ssn
         job = ssn.jobs.get(task.job)
         if job is not None:
@@ -78,6 +93,10 @@ class Statement:
         self.operations.append(("pipeline", (task, hostname)))
 
     def _unpipeline(self, task: TaskInfo) -> None:
+        self.ssn._placement_gen += 1
+        if self._ft is not None:
+            self._ft.unpipeline(task)
+            return
         ssn = self.ssn
         job = ssn.jobs.get(task.job)
         if job is not None:
@@ -96,6 +115,7 @@ class Statement:
     def allocate(self, task: TaskInfo, hostname: str) -> None:
         """Session-state allocation, logged (statement.go:199-251)."""
         ssn = self.ssn
+        ssn._placement_gen += 1
         ssn.cache.allocate_volumes(task, hostname)
         job = ssn.jobs.get(task.job)
         if job is None:
@@ -124,6 +144,7 @@ class Statement:
 
     def _unallocate(self, task: TaskInfo, reason: str) -> None:
         ssn = self.ssn
+        ssn._placement_gen += 1
         job = ssn.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.PENDING)

@@ -186,7 +186,8 @@ class DrfPlugin(Plugin):
                 self._update_share(ns_opt)
 
         ssn.add_event_handler(
-            EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+            EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate,
+                         origin=(PLUGIN_NAME, self, namespace_order_enabled))
         )
 
     def on_session_close(self, ssn) -> None:

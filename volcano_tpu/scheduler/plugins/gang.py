@@ -42,6 +42,10 @@ class GangPlugin(Plugin):
                 )
             return None
 
+        # result depends only on the job's status index (valid_task_num)
+        # and its static min_available — the session may memoize it per
+        # (job, _status_version); see Session.job_valid
+        valid_job_fn._status_version_keyed = True
         ssn.add_job_valid_fn(PLUGIN_NAME, valid_job_fn)
 
         def preemptable_fn(preemptor: TaskInfo, preemptees: List[TaskInfo]) -> List[TaskInfo]:

@@ -442,7 +442,11 @@ class PredicatesPlugin(Plugin):
                 if _anti_remove(t.uid) is not None:
                     anti_gen[0] += 1
 
-        ssn.add_event_handler(EventHandler(_track_allocate, _track_deallocate))
+        ssn.add_event_handler(EventHandler(
+            _track_allocate, _track_deallocate,
+            # the deallocate arm guards BOTH branches on status != RELEASING
+            # — the tag lets the native engine skip it for evictions
+            origin=(PLUGIN_NAME, self)))
 
         # session-scoped topology-domain index (node labels are fixed for
         # the session): key -> {value: [nodes]}, built lazily per key

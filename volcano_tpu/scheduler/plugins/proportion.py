@@ -166,7 +166,8 @@ class ProportionPlugin(Plugin):
             self._update_share(attr)
 
         ssn.add_event_handler(
-            EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+            EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate,
+                         origin=(PLUGIN_NAME, self))
         )
 
     def on_session_close(self, ssn) -> None:

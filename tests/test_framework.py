@@ -186,3 +186,30 @@ class TestPriorityQueue:
 
     def test_empty_pop(self):
         assert PriorityQueue().pop() is None
+
+
+class TestJobValidMemo:
+    def test_gang_rejection_survives_pre_registration_dispatch(self):
+        """open_session_state dispatches job_valid BEFORE plugins register;
+        the per-status-version memo must not latch a pass verdict against
+        the empty validator set (a stale hit would silently bypass gang's
+        NOT_ENOUGH_PODS rejection for every job whose status is unchanged
+        since the snapshot)."""
+        # gang of 2 but only 1 task exists -> invalid under gang
+        c, ssn = make_session_with_cluster(gang_size=1, min_member=2)
+        job = next(iter(ssn.jobs.values()))
+        vr = ssn.job_valid(job)
+        assert vr is not None and not vr.pass_, \
+            "gang must reject an under-populated gang after registration"
+        # memoized second call returns the same verdict
+        assert ssn.job_valid(job) is vr
+
+    def test_memo_invalidated_by_status_change(self):
+        c, ssn = make_session_with_cluster(gang_size=2, min_member=2)
+        job = next(iter(ssn.jobs.values()))
+        assert ssn.job_valid(job) is None  # valid gang
+        # removing a task flips validity; the version-keyed memo must see it
+        t = next(iter(job.tasks.values()))
+        job.delete_task_info(t)
+        vr = ssn.job_valid(job)
+        assert vr is not None and not vr.pass_

@@ -433,11 +433,21 @@ def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue,
         task_excl = np.full(t_count, -1, np.int32)
 
     # session signature ids from table-global ids (numbering differs from
-    # the object walk's first-encounter order; content is identical)
+    # the object walk's first-encounter order; content is identical).
+    # Table ids are small dense ints, so the dedup is bounded-id remapping
+    # (three O(T)+O(S) passes) instead of np.unique's O(T log T) sort;
+    # reversed assignment leaves each id's FIRST occurrence index.
     tsig = g["sig_id"][keep]
-    uniq, first_idx, task_sig_arr = np.unique(
-        tsig, return_index=True, return_inverse=True)
-    task_sig_arr = task_sig_arr.astype(np.int32)
+    nsig = int(tsig.max()) + 1 if tsig.size else 1
+    first = np.zeros(nsig, np.int64)
+    first[tsig[::-1]] = np.arange(tsig.size - 1, -1, -1, dtype=np.int64)
+    present = np.zeros(nsig, bool)
+    present[tsig] = True
+    uniq = np.nonzero(present)[0]
+    remap = np.zeros(nsig, np.int32)
+    remap[uniq] = np.arange(uniq.size, dtype=np.int32)
+    task_sig_arr = remap[tsig]
+    first_idx = first[uniq]
     sig_rep = [task_infos[i] for i in first_idx]
 
     task_req = np.zeros((t_count, R), np.float64)
@@ -1013,10 +1023,15 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
     gang_ready_gate = "gang" in job_ready
     job_ready_threshold = job_min_available if gang_ready_gate else np.zeros(j_count, np.int32)
 
-    order = sorted(range(j_count), key=lambda i: (jobs[i].creation_timestamp, jobs[i].uid))
+    # (ctime, uid) rank via one C-level lexsort over fixed-width columns —
+    # same order as sorted(key=(ctime, uid)) at a fraction of the cost
     job_tie_rank = np.zeros(j_count, np.int32)
-    for rank, i in enumerate(order):
-        job_tie_rank[i] = rank
+    if j_count:
+        ctimes = np.fromiter((j.creation_timestamp for j in jobs),
+                             np.float64, j_count)
+        uids = np.array([j.uid for j in jobs])  # '<U..' fixed-width
+        order_arr = np.lexsort((uids, ctimes))
+        job_tie_rank[order_arr] = np.arange(j_count, dtype=np.int32)
 
     job_alloc0 = np.zeros((j_count, R), np.float64)
     drf = ssn.plugins.get("drf")

@@ -551,13 +551,41 @@ class BatchAllocator:
         want_pods = not (
             getattr(binder0, "bind_many_keyed", None) is not None
             and getattr(binder0, "KEYED_NEEDS_PODS", True) is False)
+        # cache-mirror deferral: the reference's Bind is an async goroutine
+        # and its scheduler cache learns pod statuses from LATER watch
+        # events (cache.go:123-135,597-613) — only the SESSION state must be
+        # current inside the cycle. The cache-side half of this writeback
+        # (status flips, bucket moves, node maps, allocated sums on the
+        # cache twins) is therefore queued on the cache and applied at
+        # session close / before the next snapshot (cache.flush_mirror),
+        # halving the per-task work on the measured path. Bulk-bound tasks
+        # are disjoint from anything later actions touch through the cache
+        # effectors (they bind/evict PENDING/RUNNING tasks, never this
+        # session's BINDING set), and the deferred node deltas touch
+        # idle/used while evictions touch releasing — commutative.
+        defer_mirror = getattr(cache, "defer_mirror", None)
+        do_cache_inline = defer_mirror is None
+        if not do_cache_inline:
+            # queue BEFORE any effector runs: a store-backed binder can fire
+            # synchronous watch events whose handlers flush_mirror() — the
+            # payload must already be there so they land on a synced mirror
+            defer_mirror(dict(
+                job_nz=job_nz_arr, seg_ends=seg_ends_arr, placed=placed_arr,
+                assign=assign, task_infos=task_infos, node_names=node_names,
+                job_infos=job_infos, job_sums=job_sums,
+                scalar_names=tuple(scalar_names),
+                node_nz=np.nonzero(counts)[0], node_sums=sums))
+            self.profile["mirror_deferred"] = 1
         try:
             if fast_all is not None:
                 fast_all(
                     job_nz_arr, seg_ends_arr, placed_arr,
                     assign.astype(np.int64),
-                    task_infos, node_names, ssn_nodes, cache_nodes,
-                    job_infos, cache.jobs, PENDING, BINDING,
+                    task_infos, node_names, ssn_nodes,
+                    cache_nodes if do_cache_inline else None,
+                    job_infos,
+                    cache.jobs if do_cache_inline else None,
+                    PENDING, BINDING,
                     np.ascontiguousarray(job_sums),
                     tuple(scalar_names),
                     bind_tasks, bind_pods, bind_hosts, bind_keys,
@@ -573,7 +601,7 @@ class BatchAllocator:
                 tis = placed_l[lo:hi]
                 lo = hi
                 job = job_infos[ji]
-                cache_job = cache.jobs.get(job.uid)
+                cache_job = cache.jobs.get(job.uid) if do_cache_inline else None
                 job._status_version += 1  # direct index surgery below
                 idx = job.task_status_index
                 s_pending = idx.get(PENDING)
@@ -739,19 +767,23 @@ class BatchAllocator:
         self.profile["apply_bind_s"] = time.perf_counter() - prof_t2
         prof_t3 = time.perf_counter()
 
-        # --- bulk node accounting (session + cache trees) -----------------
+        # --- bulk node accounting (session tree; cache tree deferred) -----
+        node_nz = np.nonzero(counts)[0]
         fast_nodes = getattr(mod, "apply_node_deltas", None) \
             if mod is not None else None
         if fast_nodes is not None:
-            fast_nodes(np.nonzero(counts)[0], np.ascontiguousarray(sums),
-                       node_names, ssn_nodes, cache_nodes,
+            fast_nodes(node_nz, np.ascontiguousarray(sums),
+                       node_names, ssn_nodes,
+                       cache_nodes if do_cache_inline else None,
                        tuple(scalar_names))
         else:
             sums_l = sums.tolist()
-            for ni in np.nonzero(counts)[0].tolist():
+            for ni in node_nz.tolist():
                 vec = sums_l[ni]
                 name = node_names[ni]
-                for node in (ssn_nodes.get(name), cache_nodes.get(name)):
+                nodes_pair = (ssn_nodes.get(name), cache_nodes.get(name)) \
+                    if do_cache_inline else (ssn_nodes.get(name),)
+                for node in nodes_pair:
                     if node is None:
                         continue
                     node._acct_gen += 1  # invalidate snapshot node-axis

@@ -30,6 +30,18 @@ from volcano_tpu.scheduler.cache.interface import BindManyError
 from volcano_tpu.store import NotFoundError, Store, WatchHandler
 
 
+def _add_res_vec(res, vec, sign: float, scalar_names) -> None:
+    """res += sign * vec over the encoder's resource layout
+    (cpu, memory, *scalar_names) — the flush-side twin of the solver's
+    apply_delta (ops/solver.py _apply_bulk)."""
+    res.milli_cpu += sign * vec[0]
+    res.memory += sign * vec[1]
+    for si, name in enumerate(scalar_names):
+        q = vec[2 + si]
+        if q:
+            res.add_scalar(name, sign * q)
+
+
 def _is_terminated(status: TaskStatus) -> bool:
     return status in (TaskStatus.SUCCEEDED, TaskStatus.FAILED)
 
@@ -153,6 +165,13 @@ class SchedulerCache:
         # objects are created once above and never reassigned, so the ctx
         # stays valid for the cache's lifetime.
         self._fast_mirror = False
+        # deferred bulk-writeback payloads (ops/solver.py _apply_bulk): the
+        # cache-side half of a session's placements, applied at session
+        # close / before the next snapshot — the reference's Bind is async
+        # and its cache learns statuses from later watch events, so the
+        # mirror being one flush behind inside a cycle is the faithful
+        # semantic (cache.go:123-135,597-613)
+        self._pending_mirrors: List[dict] = []
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -230,12 +249,14 @@ class SchedulerCache:
         )
 
     def add_pod(self, pod: objects.Pod) -> None:
+        self.flush_mirror()  # watch updates must land on a flushed mirror
         with self._lock:
             if not self._responsible_for(pod):
                 return
             self._add_task(new_task_info(pod))
 
     def update_pod_from_watch(self, old_pod: objects.Pod, new_pod: objects.Pod) -> None:
+        self.flush_mirror()  # see add_pod
         with self._lock:
             self._delete_pod_locked(old_pod)
             if not self._responsible_for(new_pod):
@@ -257,12 +278,14 @@ class SchedulerCache:
             self._delete_job(job)
 
     def delete_pod(self, pod: objects.Pod) -> None:
+        self.flush_mirror()  # see add_pod
         with self._lock:
             self._delete_pod_locked(pod)
 
     # -- node handlers -----------------------------------------------------
 
     def add_node(self, node: objects.Node) -> None:
+        self.flush_mirror()  # deferred node deltas must precede a set_node/rebuild
         with self._lock:
             if node.metadata.name in self.nodes:
                 self.nodes[node.metadata.name].set_node(node)
@@ -273,6 +296,7 @@ class SchedulerCache:
         self.add_node(new)
 
     def delete_node(self, node: objects.Node) -> None:
+        self.flush_mirror()  # see add_node
         with self._lock:
             self.nodes.pop(node.metadata.name, None)
 
@@ -292,6 +316,7 @@ class SchedulerCache:
         self.add_pod_group(new)
 
     def delete_pod_group(self, pg: objects.PodGroup) -> None:
+        self.flush_mirror()  # job deletion must see flushed task state
         with self._lock:
             job_id = pod_group_job_id(pg)
             job = self.jobs.get(job_id)
@@ -471,6 +496,7 @@ class SchedulerCache:
 
     def process_resync_tasks(self) -> None:
         """Re-fetch truth from the store for tasks whose effector failed."""
+        self.flush_mirror()  # sync_task deletes/re-adds against the mirror
         tasks, self._err_tasks = self._err_tasks, []
         for task in tasks:
             try:
@@ -545,9 +571,81 @@ class SchedulerCache:
 
     # -- snapshot (cache.go:713-798) ---------------------------------------
 
+    def defer_mirror(self, payload: dict) -> None:
+        """Queue the cache-side half of a bulk writeback (see _apply_bulk);
+        applied by flush_mirror before anything reads the mirror."""
+        with self._lock:
+            self._pending_mirrors.append(payload)
+
+    def flush_mirror(self) -> None:
+        """Apply deferred bulk-writeback payloads to the cache trees:
+        status flips + bucket moves + node task-map inserts + allocated /
+        idle / used sums for every placement the session's bulk apply
+        performed. Runs entirely under the cache lock (the same discipline
+        as the effectors and watch handlers). Ordering with interleaved
+        effector calls is safe: bulk-bound tasks are disjoint from the
+        tasks bind/evict touch, and the node deltas here move idle/used
+        while evictions move releasing."""
+        with self._lock:
+            pending, self._pending_mirrors = self._pending_mirrors, []
+            if not pending:
+                return
+            BINDING = TaskStatus.BINDING
+            for p in pending:
+                task_infos = p["task_infos"]
+                node_names = p["node_names"]
+                assign = p["assign"]
+                placed = p["placed"].tolist()
+                job_sums = p["job_sums"].tolist()
+                scalar_names = p["scalar_names"]
+                lo = 0
+                for ji, hi in zip(p["job_nz"].tolist(),
+                                  p["seg_ends"].tolist()):
+                    tis = placed[lo:hi]
+                    lo = hi
+                    job = p["job_infos"][ji]
+                    cache_job = self.jobs.get(job.uid)
+                    if cache_job is None:
+                        continue
+                    cache_job._status_version += 1
+                    cidx = cache_job.task_status_index
+                    c_tasks = cache_job.tasks
+                    for ti in tis:
+                        task = task_infos[ti]
+                        ctask = c_tasks.get(task.uid)
+                        if ctask is None:
+                            continue
+                        host = node_names[int(assign[ti])]
+                        old_bucket = cidx.get(ctask.status)
+                        if old_bucket is not None:
+                            old_bucket.pop(ctask.uid, None)
+                            if not old_bucket:
+                                del cidx[ctask.status]
+                        ctask.node_name = host
+                        ctask.status = BINDING
+                        cidx.setdefault(BINDING, {})[ctask.uid] = ctask
+                        cnode = self.nodes.get(host)
+                        if cnode is not None:
+                            cnode._acct_gen += 1
+                            # the session task is shared into the cache node
+                            # map, exactly as the inline writeback did
+                            cnode.tasks[task.key] = task
+                    _add_res_vec(cache_job.allocated, job_sums[ji],
+                                 +1.0, scalar_names)
+                sums = p["node_sums"].tolist()
+                for ni in p["node_nz"].tolist():
+                    cnode = self.nodes.get(node_names[ni])
+                    if cnode is None:
+                        continue
+                    cnode._acct_gen += 1
+                    vec = sums[ni]
+                    _add_res_vec(cnode.idle, vec, -1.0, scalar_names)
+                    _add_res_vec(cnode.used, vec, +1.0, scalar_names)
+
     def snapshot(self) -> ClusterInfo:
         from volcano_tpu.scheduler.cache.nodeaxis import capture_node_axis
 
+        self.flush_mirror()
         with self._lock:
             snap = ClusterInfo()
             for node in self.nodes.values():

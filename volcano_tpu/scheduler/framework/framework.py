@@ -45,6 +45,13 @@ def open_session(cache, tiers: List[conf.Tier]) -> Session:
 
 
 def close_session(ssn: Session) -> None:
+    # apply any cache-mirror work the bulk writeback deferred off the
+    # in-session critical path (solver._apply_bulk; the reference's bind
+    # is async and its cache syncs from later watch events) — plugins'
+    # on_session_close and the job updater read the cache below
+    flush = getattr(ssn.cache, "flush_mirror", None)
+    if flush is not None:
+        flush()
     for plugin in ssn.plugins.values():
         start = time.perf_counter()
         plugin.on_session_close(ssn)

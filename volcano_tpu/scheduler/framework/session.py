@@ -219,19 +219,25 @@ class Session:
         # preempt/reclaim/backfill each dispatch this once per job; when
         # every registered validator declares itself a pure function of the
         # job's status index (the stock gang one does), the verdict is
-        # memoized per (job, _status_version)
-        memo = self._job_valid_memo
-        if memo is None:
-            memo = self._job_valid_memo = (
-                {} if all(getattr(fn, "_status_version_keyed", False)
-                          for fn in self.job_valid_fns.values()) else False)
+        # memoized per (job, _status_version). The gate is keyed to the
+        # validator COUNT: open_session_state dispatches job_valid before
+        # plugins register, and a memo latched against the empty (or any
+        # smaller) fn set must be discarded when registration grows it.
+        fns = self.job_valid_fns
+        if not fns:
+            return None
+        gate = self._job_valid_memo
+        if gate is None or gate[0] != len(fns):
+            memo = ({} if all(getattr(fn, "_status_version_keyed", False)
+                              for fn in fns.values()) else False)
+            gate = self._job_valid_memo = (len(fns), memo)
+        memo = gate[1]
         if memo is not False:
-            key = job.uid
-            hit = memo.get(key)
+            hit = memo.get(job.uid)
             if hit is not None and hit[0] == job._status_version:
                 return hit[1]
         vr_out = None
-        for tier_fns in self._tier_plugins(None, self.job_valid_fns):
+        for tier_fns in self._tier_plugins(None, fns):
             for fn in tier_fns:
                 vr = fn(job)
                 if vr is not None and not vr.pass_:

@@ -540,9 +540,9 @@ def solve_rounds_packed(spec: SolveSpec, layout, bufs):
         name: lax.slice_in_dim(bufs[key], off, off + size).reshape(shape)
         for name, key, off, size, shape in layout
     }
-    assign, n_rounds = solve_rounds.__wrapped__(spec, enc)
+    assign, n_rounds, tail_placed = solve_rounds.__wrapped__(spec, enc)
     n_total = enc["node_idle"].shape[0]
-    tail = jnp.stack([n_rounds & 0x7FFF, n_rounds >> 15])
+    tail = jnp.stack([n_rounds & 0x7FFF, n_rounds >> 15, tail_placed])
     if n_total <= 32766:  # static (trace-time) shape decision
         return jnp.concatenate([assign.astype(jnp.int16),
                                 tail.astype(jnp.int16)])
@@ -768,6 +768,103 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         return dict(st, tried_cons=jnp.bool_(False))
 
     st = lax.while_loop(outer_cond, outer_body, st)
+
+    def tail_pass(st):
+        """Sequential per-task placement of the diminishing-returns
+        remainder, on device, in the serial visit order: one task per step
+        (lowest live task rank), class-row feasibility mask, fused score,
+        argmax node (first-max == lowest node index, the serial tie-break),
+        scatter-commit. The cap condition bounds the remainder at
+        8 * round_min_progress, so ~300 tiny [N]-vector steps replace a
+        host residue pass that costs ~0.7 ms per straggler. Tasks the
+        sweep cannot place are retired with assign -1 (the kernel's mask
+        equals the serial predicate verdict for modeled tasks); gangs left
+        short are stripped and re-enqueued below exactly as before."""
+        big_rank = jnp.int32(2**30)
+        tail_budget = jnp.int32(8 * max(spec.round_min_progress, 1) + 16)
+
+        def cond(s):
+            return jnp.any(s["active"]) & ~s["tail_stuck"] \
+                & (s["tail_steps"] < tail_budget)
+
+        def body(s):
+            eligible = s["active"]
+            if spec.use_prop_overused:
+                # overused queues sit out (the serial gate between job
+                # visits); their tasks stay ACTIVE so the capped -2 marking
+                # below still routes them to the serial residue retry,
+                # exactly as the pre-tail capped exit did
+                over = ~_le_eps_rows(s["queue_alloc"], enc["queue_deserved"],
+                                     enc["eps"], enc["is_scalar"])
+                eligible = eligible & ~over[task_queue]
+            job_rank = _job_rank(spec, enc, s["job_placed"], s["job_alloc"])
+            task_rank = job_rank[task_job] * max_tasks_per_job + task_in_job
+            t = jnp.argmin(jnp.where(eligible, task_rank, big_rank))
+            has = jnp.any(eligible)
+            c = enc["task_cls"][t]
+            req = enc["cls_req"][c]
+            initreq = enc["cls_initreq"][c]
+            eps = enc["eps"]
+            is_scalar = enc["is_scalar"]
+            le = initreq[None, :] < s["idle"] + eps[None, :]
+            skip = is_scalar[None, :] & (initreq[None, :] <= MIN_MILLI_SCALAR)
+            mask = jnp.all(le | skip, axis=-1) & enc["sig_mask"][enc["cls_sig"][c]]
+            if spec.check_pod_count:
+                mask = mask & ((s["cnt"] < enc["node_max_tasks"])
+                               | ~enc["cls_has_pod"][c])
+            if spec.use_exclusion:
+                g = task_excl[t]
+                mask = mask & ~(s["excl_occ"][jnp.maximum(g, 0)] & (g >= 0))
+            score = fused_scores(spec, enc, s["used"], req,
+                                 enc["cls_nz_cpu"][c], enc["cls_nz_mem"][c],
+                                 enc["cls_sig"][c])
+            node = jnp.argmax(jnp.where(mask, score,
+                                        jnp.array(-jnp.inf, score.dtype)))
+            ok = has & mask[node]
+            dreq = jnp.where(ok, req, jnp.zeros_like(req)).astype(dt)
+            out = dict(
+                s,
+                idle=s["idle"].at[node].add(-dreq),
+                used=s["used"].at[node].add(dreq),
+                cnt=s["cnt"].at[node].add(ok.astype(jnp.int32)),
+                assign=s["assign"].at[t].set(
+                    jnp.where(ok, node.astype(jnp.int32), s["assign"][t])),
+                # the selected task retires either way: placed now, or
+                # handed to the serial residue retry (tail_failed) — the
+                # post-tail gang strip can refund capacity, so an
+                # infeasible-now verdict is not final for the session
+                active=s["active"].at[t].set(jnp.where(has, False,
+                                                       s["active"][t])),
+                tail_failed=s["tail_failed"].at[t].set(
+                    jnp.where(has & ~ok, True, s["tail_failed"][t])),
+                tail_stuck=~has,
+                job_placed=s["job_placed"].at[task_job[t]].add(
+                    ok.astype(jnp.int32)),
+                job_alloc=s["job_alloc"].at[task_job[t]].add(dreq),
+                queue_alloc=s["queue_alloc"].at[task_queue[t]].add(dreq),
+                ns_alloc=s["ns_alloc"].at[task_ns[t]].add(dreq),
+                tail_steps=s["tail_steps"] + 1,
+                tail_placed=s["tail_placed"] + ok.astype(jnp.int32),
+            )
+            if spec.use_exclusion:
+                out["excl_occ"] = s["excl_occ"].at[
+                    jnp.maximum(task_excl[t], 0), node].max(
+                        ok & (task_excl[t] >= 0))
+            return out
+
+        s = dict(st, tail_steps=jnp.int32(0), tail_stuck=jnp.bool_(False),
+                 tail_placed=jnp.int32(0),
+                 tail_failed=jnp.zeros_like(st["active"]))
+        s = lax.while_loop(cond, body, s)
+        s.pop("tail_steps")
+        s.pop("tail_stuck")
+        return s
+
+    if spec.round_min_progress > 1:
+        st = lax.cond(st["capped"], tail_pass,
+                      lambda s: dict(s, tail_placed=jnp.int32(0),
+                                     tail_failed=jnp.zeros_like(s["active"])),
+                      st)
     # structural gang-atomicity net: on a normal exit (dead=True) no gang
     # with placements is short, so this is a no-op; on a budget exhaustion
     # it strips partially-placed gangs instead of letting the bulk apply
@@ -781,12 +878,16 @@ def solve_rounds(spec: SolveSpec, enc: dict):
     # proven unplaceable) are NOT re-enqueued: dumping them on the serial
     # pass would cost far more host work than the rounds the cap saved.
     strip_retry = short & (st["job_placed"] > 0)
+    want_retry = st["active"] | (strip_retry[task_job] & task_valid)
+    if "tail_failed" in st:
+        # tasks the device tail judged infeasible retry serially too: the
+        # gang strip above may have refunded capacity they can use (the
+        # tail saw idle still charged with the stripped placements)
+        want_retry = want_retry | (st["tail_failed"] & task_valid)
     assign = jnp.where(
-        st["capped"]
-        & (st["active"] | (strip_retry[task_job] & task_valid))
-        & (assign < 0),
+        st["capped"] & want_retry & (assign < 0),
         -2, assign)
-    return assign, st["rounds"]
+    return assign, st["rounds"], st.get("tail_placed", jnp.int32(0))
 
 
 def _le_eps_rows(l, r, eps, is_scalar):

@@ -347,17 +347,23 @@ class BatchAllocator:
                     tp = time.perf_counter()
                     out = np.asarray(rounds_mod.solve_rounds_packed(
                         spec, layout, staged))
-                    assign = out[:-2].astype(np.int32, copy=False)
-                    n_rounds = int(out[-2]) | (int(out[-1]) << 15)
+                    assign = out[:-3].astype(np.int32, copy=False)
+                    n_rounds = int(out[-3]) | (int(out[-2]) << 15)
+                    tail_placed = int(out[-1])
                     self.profile["pack_s"] = tp - t1
                     self.profile["dispatch_s"] = time.perf_counter() - tp
                 else:
                     # mesh path keeps per-array puts: node-axis arrays carry
                     # NamedShardings that packing would destroy
-                    assign, n_rounds = rounds_mod.solve_rounds(
+                    assign, n_rounds, tail_placed = rounds_mod.solve_rounds(
                         spec, rounds_arrays)
+                    tail_placed = int(tail_placed)
                 assign = np.asarray(assign)
                 self.profile["rounds"] = int(n_rounds)
+                if tail_placed:
+                    # diminishing-returns cap fired and the device tail
+                    # placed the stragglers (rounds.py tail_pass)
+                    self.profile["tail_placed"] = tail_placed
             else:
                 assign, rr = kernels.solve_allocate(
                     enc.spec, arrays, np.int32(enc.rr0), np.int32(enc.num_to_find)
@@ -565,17 +571,6 @@ class BatchAllocator:
         # idle/used while evictions touch releasing — commutative.
         defer_mirror = getattr(cache, "defer_mirror", None)
         do_cache_inline = defer_mirror is None
-        if not do_cache_inline:
-            # queue BEFORE any effector runs: a store-backed binder can fire
-            # synchronous watch events whose handlers flush_mirror() — the
-            # payload must already be there so they land on a synced mirror
-            defer_mirror(dict(
-                job_nz=job_nz_arr, seg_ends=seg_ends_arr, placed=placed_arr,
-                assign=assign, task_infos=task_infos, node_names=node_names,
-                job_infos=job_infos, job_sums=job_sums,
-                scalar_names=tuple(scalar_names),
-                node_nz=np.nonzero(counts)[0], node_sums=sums))
-            self.profile["mirror_deferred"] = 1
         try:
             if fast_all is not None:
                 fast_all(
@@ -698,6 +693,20 @@ class BatchAllocator:
 
         self.profile["apply_loop_s"] = time.perf_counter() - prof_t1
         prof_t2 = time.perf_counter()
+
+        if not do_cache_inline:
+            # queued only after the session-side loop SUCCEEDED (a loop
+            # failure must not leave the cache applying phantom
+            # placements), and before any effector runs — a store-backed
+            # binder can fire synchronous watch events whose handlers
+            # flush_mirror(), and they must land on a synced mirror
+            defer_mirror(dict(
+                job_nz=job_nz_arr, seg_ends=seg_ends_arr, placed=placed_arr,
+                assign=assign, task_infos=task_infos, node_names=node_names,
+                job_infos=job_infos, job_sums=job_sums,
+                scalar_names=tuple(scalar_names),
+                node_nz=np.nonzero(counts)[0], node_sums=sums))
+            self.profile["mirror_deferred"] = 1
 
         # --- batch binder + events ----------------------------------------
         binder = cache.binder

@@ -1,0 +1,245 @@
+"""Full-action-pipeline churn soak: ~18 consecutive sessions on ONE evolving
+overcommitted cache, conf = enqueue + allocate + backfill + preempt +
+reclaim, rounds mode forced — preemption and reclamation fire ACROSS
+cycles, with a simulated kubelet (bound pods flip to Running, evicted pods
+get deleted a cycle later) so the eviction -> releasing -> pipelined ->
+deleted -> re-placed lifecycle actually turns over (reference analog:
+test/e2e/job_error_handling.go's continuously reconciling evict/restart
+suites).
+
+Asserted:
+- accounting oracle every cycle: node used/idle/releasing and job
+  allocated recomputed from first principles match the incremental state —
+  THE stale-state detector for the preempt-view/victim-view/fused-
+  transition caches under churn;
+- every eviction the effector records corresponds to a cache task that is
+  RELEASING (until the kubelet deletes it);
+- preempt fires (high-priority gangs land while lower-priority tasks get
+  evicted) and reclaim fires (the starved queue's share grows);
+- pipelined placements resolve: tasks the session pipelined onto releasing
+  capacity are bound in a later cycle once victims die;
+- gang atomicity on new placements, nothing binds twice, the drained node
+  receives nothing after the drain;
+- ZERO steady-state XLA recompiles (cycle >= 4) with the full program-
+  variant set live.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.helpers import close_session, make_cache, make_tiers, open_session
+from volcano_tpu.api import objects
+from volcano_tpu.api.types import TaskStatus, allocated_status
+from volcano_tpu.scheduler.framework import get_action
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node, build_pod, build_pod_group, build_queue,
+    build_resource_list_with_pods,
+)
+from volcano_tpu.utils.jaxcompile import CompileWatcher
+
+CYCLES = 18
+NODES = 48
+GANG = 4
+
+TIERS = (["priority", "gang"],
+         ["drf", "predicates", "proportion", "nodeorder"])
+ACTIONS = ("enqueue", "allocate", "backfill", "preempt", "reclaim")
+
+
+def _add_job(cache, name: str, queue: str, priority: int, cpu: str,
+             best_effort: bool = False, min_member: int = GANG) -> None:
+    cache.add_pod_group(build_pod_group(
+        name, namespace="soak", min_member=min_member, queue=queue,
+        phase=objects.PodGroupPhase.PENDING))
+    for i in range(GANG):
+        req = {} if best_effort else {"cpu": cpu, "memory": "512Mi"}
+        cache.add_pod(build_pod(
+            "soak", f"{name}-t{i}", "", objects.POD_PHASE_PENDING,
+            req, name, priority=priority))
+
+
+def _kubelet_start_bound(cache) -> int:
+    """Simulated kubelet: freshly bound pods flip to Running via the watch
+    path (the scheduler only preempts RUNNING victims)."""
+    started = 0
+    for job in list(cache.jobs.values()):
+        for t in list(job.tasks.values()):
+            if t.status in (TaskStatus.BINDING, TaskStatus.BOUND) \
+                    and t.pod is not None:
+                pod = t.pod
+                pod.spec.node_name = t.node_name
+                pod.status.phase = objects.POD_PHASE_RUNNING
+                cache.update_pod_from_watch(pod, pod)
+                started += 1
+    return started
+
+
+def _kubelet_kill_releasing(cache) -> int:
+    """Simulated kubelet/controller: evicted (RELEASING) pods die, freeing
+    their capacity for the tasks pipelined onto it."""
+    victims = [t.pod for job in cache.jobs.values()
+               for t in job.tasks.values()
+               if t.status == TaskStatus.RELEASING and t.pod is not None]
+    for pod in victims:
+        cache.delete_pod(pod)
+    return len(victims)
+
+
+def _assert_accounting(cache, cycle) -> None:
+    for name, node in cache.nodes.items():
+        used_cpu = sum(t.resreq.milli_cpu for t in node.tasks.values())
+        rel_cpu = sum(t.resreq.milli_cpu for t in node.tasks.values()
+                      if t.status == TaskStatus.RELEASING)
+        assert abs(node.used.milli_cpu - used_cpu) < 1e-6, (cycle, name)
+        assert abs(node.releasing.milli_cpu - rel_cpu) < 1e-6, (cycle, name)
+        if node.allocatable is not None:
+            assert abs(node.idle.milli_cpu + used_cpu
+                       - node.allocatable.milli_cpu) < 1e-6, (cycle, name)
+    for uid, job in cache.jobs.items():
+        alloc_cpu = sum(t.resreq.milli_cpu for t in job.tasks.values()
+                        if allocated_status(t.status))
+        assert abs(job.allocated.milli_cpu - alloc_cpu) < 1e-6, (cycle, uid)
+
+
+@pytest.mark.slow
+def test_full_pipeline_churn_soak():
+    cache = make_cache()
+    cache.add_queue(build_queue("qa", weight=1))
+    cache.add_queue(build_queue("qb", weight=1))
+    for n in range(NODES):
+        cache.add_node(build_node(
+            f"node-{n:03d}",
+            build_resource_list_with_pods("16", "32Gi", pods=48)))
+    # initial low-priority filler saturates the 768-cpu cluster;
+    # min_member=2 of 4 leaves two evictable members per gang (a gang at
+    # min_member == size is never preemptable — gang.go:82-86)
+    for j in range(48):
+        _add_job(cache, f"fill-000-{j:03d}", "qa", 1, "4", min_member=2)
+    tiers = make_tiers(["tpuscore"], *TIERS)
+
+    watcher = CompileWatcher.install()
+    drained = "node-005"
+    all_bound: dict = {}
+    recompiles = []
+    evictions_total = 0
+    qb_bound = 0
+    pipelined_waiting: dict = {}  # key -> cycle first seen pipelined
+    pipelined_resolved = 0
+    preempt_cycles = 0
+
+    for cycle in range(CYCLES):
+        # ---- world churn BEFORE the cycle's session ----------------------
+        if cycle > 0:
+            _kubelet_start_bound(cache)
+            killed = _kubelet_kill_releasing(cache)
+            assert killed == evictions_pending, (cycle, killed)
+        if cycle == 6:
+            # drain (cordon) via the watch path: spec flip + node update
+            node_obj = cache.nodes[drained].node
+            node_obj.spec.unschedulable = True
+            cache.add_node(node_obj)
+        if cycle >= 1:
+            # completions: ~12% of the oldest Running pods finish, so
+            # capacity churns and table rows recycle
+            running = sorted(
+                (t.pod for job in cache.jobs.values()
+                 for t in job.tasks.values()
+                 if t.status == TaskStatus.RUNNING and t.pod is not None),
+                key=lambda pp: (pp.metadata.namespace, pp.metadata.name))
+            for pod in running[:max(1, len(running) // 8)]:
+                cache.delete_pod(pod)
+            # keep qa saturated with low-priority filler
+            for j in range(8):
+                _add_job(cache, f"fill-{cycle:03d}-{j:03d}", "qa", 1, "4",
+                         min_member=2)
+            # best-effort pods exercise backfill
+            _add_job(cache, f"be-{cycle:03d}", "qa", 1, "0",
+                     best_effort=True)
+        if cycle >= 2:
+            # high-priority gangs in qa force preemption under saturation
+            for j in range(4):
+                _add_job(cache, f"hi-{cycle:03d}-{j:03d}", "qa", 10, "2")
+        if cycle >= 3:
+            # starved queue-b demand forces reclaim from qa's overage
+            for j in range(2):
+                _add_job(cache, f"qb-{cycle:03d}-{j:03d}", "qb", 5, "2")
+
+        # ---- one full-pipeline session ----------------------------------
+        ev_before = len(cache.evictor.evicts)
+        before = set(cache.binder.binds)
+        win = watcher.window()
+        ssn = open_session(cache, tiers)
+        if ssn.batch_allocator is not None:
+            ssn.batch_allocator.mode = "rounds"
+        for name in ACTIONS:
+            get_action(name).execute(ssn)
+        # capture session-local pipelined placements before close
+        pipelined_now = [
+            t.key for job in ssn.jobs.values()
+            for t in job.task_status_index.get(
+                TaskStatus.PIPELINED, {}).values()]
+        close_session(ssn)
+        recompiles.append(win.delta().compiles)
+
+        new = {k: cache.binder.binds[k]
+               for k in set(cache.binder.binds) - before}
+        evicted_this = len(cache.evictor.evicts) - ev_before
+        evictions_total += evicted_this
+        evictions_pending = sum(
+            1 for job in cache.jobs.values() for t in job.tasks.values()
+            if t.status == TaskStatus.RELEASING)
+        if evicted_this:
+            preempt_cycles += 1
+
+        # ---- per-cycle assertions ---------------------------------------
+        _assert_accounting(cache, cycle)
+        # every recorded eviction leaves a RELEASING cache task (until the
+        # kubelet deletes it next cycle); evictions within one session are
+        # unique tasks, so counts line up
+        assert evictions_pending == evicted_this, (
+            cycle, evictions_pending, evicted_this)
+        if cycle > 6:
+            assert not any(v == drained for v in new.values()), cycle
+        dup = set(new) & set(all_bound)
+        assert not dup, (cycle, sorted(dup)[:3])
+        all_bound.update(new)
+        qb_bound += sum(1 for k in new if k.split("/")[1].startswith("qb-"))
+
+        # pipelined placements must resolve to binds in later cycles
+        for key in list(pipelined_waiting):
+            if key in all_bound:
+                pipelined_waiting.pop(key)
+                pipelined_resolved += 1
+        for key in pipelined_now:
+            pipelined_waiting.setdefault(key, cycle)
+
+        # gang atomicity on new placements: a gang below min_available
+        # must not appear partially unless earlier cycles already bound it
+        per_pg: dict = {}
+        for key in new:
+            pg = key.split("/", 1)[1].rsplit("-", 1)[0]
+            per_pg[pg] = per_pg.get(pg, 0) + 1
+        for pg in per_pg:
+            job = cache.jobs.get(f"soak/{pg}")
+            if job is not None:
+                prior = sum(
+                    1 for k in all_bound
+                    if k.split("/", 1)[1].rsplit("-", 1)[0] == pg)
+                assert prior >= job.min_available, (cycle, pg, prior)
+
+    # ---- whole-soak assertions ------------------------------------------
+    assert evictions_total >= 3 * GANG, evictions_total  # preempt/reclaim real
+    assert preempt_cycles >= 3, preempt_cycles           # ...across cycles
+    assert qb_bound >= GANG, qb_bound                    # reclaim landed qb work
+    # pipelined-onto-releasing placements resolved once victims died.
+    # Low-priority fillers may legitimately starve behind the endless
+    # high-priority arrivals (that IS the scheduler working), so the
+    # must-resolve guarantee applies to the high-priority preemptors —
+    # nothing outranks them, their victims die next cycle
+    assert pipelined_resolved >= 1, (pipelined_resolved, pipelined_waiting)
+    unresolved_hi = {k: c for k, c in pipelined_waiting.items()
+                     if k.startswith("soak/hi-") and c < CYCLES - 2}
+    assert not unresolved_hi, unresolved_hi
+    # zero steady-state recompiles with the full variant set live
+    assert all(c == 0 for c in recompiles[4:]), recompiles

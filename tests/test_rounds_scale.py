@@ -190,6 +190,50 @@ class TestMidScaleQualityGate:
                 s_shares, r_shares)
 
 
+@pytest.mark.slow
+class TestShardedUnshardedParity:
+    def test_mesh_bindings_equal_single_device_10k(self):
+        """The reference's guarantee that 16-worker parallel predicate/
+        score is decision-identical to serial (scheduler_helper.go:64-118)
+        maps here to: the rounds solve sharded over the 8-device mesh must
+        produce EXACTLY the bindings of the single-device solve. The solve
+        is deterministic — scores are elementwise per node, the conflict
+        cumsums are exact integer limbs, argmax ties break by index — so
+        any divergence is a sharding bug (e.g. in the non-divisible
+        node-axis padding masks). ~10k tasks x 1000 nodes (1000 % 8 == 0
+        is avoided: 998 nodes forces real padding)."""
+        devs = jax.devices()
+        assert len(devs) >= 8, devs
+        populate = _mixed_cluster(
+            n_groups=2500, group_size=4, min_member=2, n_nodes=998,
+            queues=3, seed=59, node_cpu="8", node_mem="16Gi")
+
+        def run(mesh):
+            cache = make_cache()
+            populate(cache)
+            ssn = open_session(cache, make_tiers(
+                ["tpuscore"], *DEFAULT_TIERS, arguments=ROUNDS_ARGS))
+            if mesh is not None:
+                ssn.plugins["tpuscore"].mesh = mesh
+                ssn.batch_allocator.mesh = mesh
+            get_action("allocate").execute(ssn)
+            prof = dict(ssn.plugins["tpuscore"].profile)
+            close_session(ssn)
+            assert prof.get("mode") == "rounds", prof
+            assert "fallback" not in prof, prof
+            return dict(cache.binder.binds), prof
+
+        sharded, s_prof = run(Mesh(np.array(devs[:8]), ("nodes",)))
+        unsharded, u_prof = run(None)
+        assert len(sharded) >= 9000, len(sharded)
+        assert sharded == unsharded, (
+            f"sharded vs unsharded bindings diverge: "
+            f"{len(sharded)} vs {len(unsharded)} binds; "
+            f"first diffs: "
+            f"{[(k, sharded.get(k), unsharded.get(k)) for k in list(set(sharded) ^ set(unsharded))[:3]] or [(k, sharded[k], unsharded[k]) for k in sharded if sharded[k] != unsharded.get(k)][:3]}")
+        assert s_prof.get("rounds") == u_prof.get("rounds"), (s_prof, u_prof)
+
+
 class TestFuzzInvariants:
     """Seeded fuzz: random heterogeneous clusters (selectors, taints,
     tolerations, scalar resources, priorities, varying gang sizes, tight

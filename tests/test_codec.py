@@ -1,0 +1,57 @@
+"""Wire codec (api/codec.py): JSON-safe roundtrips over the object zoo."""
+
+from __future__ import annotations
+
+import json
+
+from volcano_tpu.api import codec, objects
+from volcano_tpu.cli.job import job_from_yaml
+from volcano_tpu.cli.vcctl import DEMO_JOB_YAML
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node, build_pod, build_pod_group, build_queue,
+    build_resource_list_with_pods,
+)
+
+
+def _roundtrip(obj):
+    env = codec.envelope(obj)
+    back = codec.from_envelope(json.loads(json.dumps(env)))
+    assert codec.to_wire(back) == codec.to_wire(obj), type(obj).__name__
+    return back
+
+
+def test_roundtrip_object_zoo():
+    pod = build_pod("ns", "p1", "n1", "Running", {"cpu": "1"}, "pg",
+                    labels={"a": "b"})
+    pod.spec.affinity = objects.Affinity(
+        pod_anti_affinity=objects.PodAntiAffinity(required_terms=[
+            objects.PodAffinityTerm(
+                label_selector=objects.LabelSelector(match_labels={"x": "y"}),
+                topology_key="kubernetes.io/hostname")]))
+    for obj in (
+        build_node("n1", build_resource_list_with_pods("4", "8Gi")),
+        pod,
+        build_pod_group("pg", min_member=3),
+        build_queue("q", weight=2),
+        job_from_yaml(DEMO_JOB_YAML),
+        objects.Command(
+            metadata=objects.ObjectMeta(name="c"), action="AbortJob",
+            target_object=objects.OwnerReference(kind="Job", name="j")),
+    ):
+        _roundtrip(obj)
+
+
+def test_nested_optionals_and_unknown_fields():
+    pod = build_pod("ns", "p", "", "Pending", {}, "")
+    wire = codec.envelope(pod)
+    wire["object"]["not_a_field"] = 42  # forward compatibility: ignored
+    back = codec.from_envelope(wire)
+    assert back.metadata.name == "p"
+    assert back.spec.affinity is None
+
+
+def test_every_store_kind_registered():
+    for kind in ("Pod", "Node", "PodGroup", "Queue", "Job", "Command",
+                 "PriorityClass", "ResourceQuota", "PodDisruptionBudget",
+                 "PersistentVolumeClaim", "ConfigMap", "Service"):
+        assert codec.kind_class(kind).KIND == kind

@@ -1,13 +1,20 @@
 """vcctl — the CLI entry (volcano cmd/cli/vcctl.go:34).
 
-The reference talks to an API server; this framework's state store is
-in-process, so the CLI binds to a cluster instance: either the interactive
-``demo`` subcommand (spins a full Cluster, runs a job end-to-end, prints the
-tables) or library use against any Store (see cli/job.py, cli/queue.py).
-A networked mode arrives with the gRPC bridge (SURVEY.md §7 stage 5).
+Two modes, mirroring the reference's remote-client design:
 
-    python -m volcano_tpu.cli.vcctl demo
-    python -m volcano_tpu.cli.vcctl demo --job example/job.yaml
+- ``--server host:port`` drives a LIVE cluster process over HTTP through
+  the store gateway (``python -m volcano_tpu.scheduler --api-address``),
+  exactly as the reference vcctl is a network client of the API server
+  (pkg/cli/job/run.go:55-80). All job/queue subcommands work this way:
+
+      vcctl --server localhost:11280 job run -f example/job.yaml
+      vcctl --server localhost:11280 job list
+      vcctl --server localhost:11280 job suspend -n default -N test-job
+      vcctl --server localhost:11280 queue list
+
+- ``demo`` spins a full in-process Cluster and runs a job end-to-end
+  (library use against any Store stays available via cli/job.py,
+  cli/queue.py).
 """
 
 from __future__ import annotations
@@ -97,16 +104,93 @@ def demo(args) -> int:
     return 0
 
 
+def _remote(args):
+    from volcano_tpu.store.remote import RemoteStore
+
+    if not args.server:
+        print("error: this subcommand needs --server host:port "
+              "(a cluster process run with --api-address)", file=sys.stderr)
+        return None
+    return RemoteStore(args.server)
+
+
+def run_remote(args) -> int:
+    store = _remote(args)
+    if store is None:
+        return 2
+    cmd, sub = args.command, args.subcommand
+    try:
+        if cmd == "job":
+            if sub == "run":
+                with open(args.file) as f:
+                    job = job_cli.run_job(store, f.read())
+                print(f"job {job.metadata.namespace}/{job.metadata.name} created")
+            elif sub == "list":
+                print(job_cli.list_jobs(
+                    store, namespace=args.namespace,
+                    all_namespaces=args.all_namespaces,
+                    selector=args.selector), end="")
+            elif sub == "view":
+                print(job_cli.view_job(store, args.namespace, args.name), end="")
+            elif sub == "suspend":
+                job_cli.suspend_job(store, args.namespace, args.name)
+                print(f"suspend command issued for {args.namespace}/{args.name}")
+            elif sub == "resume":
+                job_cli.resume_job(store, args.namespace, args.name)
+                print(f"resume command issued for {args.namespace}/{args.name}")
+            elif sub == "delete":
+                job_cli.delete_job(store, args.namespace, args.name)
+                print(f"job {args.namespace}/{args.name} deleted")
+        elif cmd == "queue":
+            if sub == "create":
+                queue_cli.create_queue(store, args.name, weight=args.weight)
+                print(f"queue {args.name} created")
+            elif sub == "get":
+                print(queue_cli.get_queue(store, args.name), end="")
+            elif sub == "list":
+                print(queue_cli.list_queues(store), end="")
+        return 0
+    except Exception as e:  # served-boundary errors print, not traceback
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="vcctl")
+    ap.add_argument("--server", default="",
+                    help="cluster API gateway host:port (remote mode)")
     sub = ap.add_subparsers(dest="command", required=True)
+
     demo_p = sub.add_parser("demo", help="run a full in-process cluster demo")
     demo_p.add_argument("--job", help="job YAML file (default: built-in MPI-style job)")
     demo_p.add_argument("--nodes", type=int, default=3)
+
+    job_p = sub.add_parser("job", help="job operations (remote: --server)")
+    job_sub = job_p.add_subparsers(dest="subcommand", required=True)
+    p = job_sub.add_parser("run")
+    p.add_argument("-f", "--file", required=True)
+    p = job_sub.add_parser("list")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--all-namespaces", action="store_true")
+    p.add_argument("--selector", default="")
+    for name in ("view", "suspend", "resume", "delete"):
+        p = job_sub.add_parser(name)
+        p.add_argument("-n", "--namespace", default="default")
+        p.add_argument("-N", "--name", required=True)
+
+    queue_p = sub.add_parser("queue", help="queue operations (remote: --server)")
+    queue_sub = queue_p.add_subparsers(dest="subcommand", required=True)
+    p = queue_sub.add_parser("create")
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("-w", "--weight", type=int, default=1)
+    p = queue_sub.add_parser("get")
+    p.add_argument("-N", "--name", required=True)
+    queue_sub.add_parser("list")
+
     args = ap.parse_args(argv)
     if args.command == "demo":
         return demo(args)
-    return 1
+    return run_remote(args)
 
 
 if __name__ == "__main__":

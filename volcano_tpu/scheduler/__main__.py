@@ -58,6 +58,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                     default=d.percentage_of_nodes_to_find)
     ap.add_argument("--cluster-state", default="",
                     help="YAML corpus seeding nodes/queues/jobs (example/)")
+    ap.add_argument("--api-address", default="",
+                    help="serve the store API gateway (vcctl --server "
+                         "target) on this address; ':0' picks a free port")
     ap.add_argument("--run-for", type=float, default=0.0,
                     help="exit after N seconds (0 = until SIGINT)")
     ap.add_argument("--version", action="store_true")
@@ -150,6 +153,17 @@ def main(argv=None) -> int:
     logging.info("metrics on :%d/metrics, healthz on :%d/healthz",
                  metrics_srv.port, healthz_srv.port)
 
+    api_srv = None
+    if args.api_address:
+        from volcano_tpu.store.gateway import ApiGateway
+
+        api_srv = ApiGateway(cluster.store, args.api_address).start()
+        # the flush=True print is the port-discovery contract for tools
+        # spawning this process with --api-address :0
+        print(f"api gateway on :{api_srv.port}", flush=True)
+        logging.info("api gateway on :%d (vcctl --server target)",
+                     api_srv.port)
+
     if args.leader_elect:
         import os
         import socket
@@ -192,6 +206,8 @@ def main(argv=None) -> int:
         elector.stop()
     else:
         cluster.stop()
+    if api_srv is not None:
+        api_srv.stop()
     metrics_srv.stop()
     healthz_srv.stop()
     return 0

@@ -1,0 +1,200 @@
+"""HTTP/JSON gateway over the store — the API-server seam for remote
+clients.
+
+The reference's vcctl is a network client of the Kubernetes API server
+(cmd/cli/vcctl.go:34; pkg/cli/job/run.go:55-80 creates Jobs over HTTP).
+This gateway gives the in-process store the same served surface so
+``vcctl --server host:port`` (store/remote.py RemoteStore) drives a live
+cluster process from outside:
+
+    POST   /apis/{Kind}                      create   (envelope body)
+    GET    /apis/{Kind}?namespace=&selector= list     ({"items": [...]})
+    GET    /apis/{Kind}/{ns}/{name}          get      ("-" = cluster scope)
+    PUT    /apis/{Kind}/{ns}/{name}?expect=  update   (CAS via expect)
+    DELETE /apis/{Kind}/{ns}/{name}          delete
+    GET    /events/{Kind}/{ns}/{name}        recorded events
+    GET    /healthz
+
+Admission runs server-side exactly as for in-process writes (store.create
+applies mutators/validators); AdmissionError maps to 422, ConflictError
+to 409, NotFoundError to 404. Objects travel as api/codec.py envelopes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlsplit
+
+from volcano_tpu.api import codec
+from volcano_tpu.scheduler.httpserver import _parse_address
+from volcano_tpu.store.store import (
+    AdmissionError, ConflictError, NotFoundError, Store)
+
+logger = logging.getLogger(__name__)
+
+
+class ApiGateway:
+    """Serves the store over HTTP; port 0 picks a free port (``.port``).
+
+    Binds loopback by default (':0' -> 127.0.0.1): this is an
+    UNAUTHENTICATED read-write API — exposing it beyond the host must be
+    an explicit operator choice (--api-address 0.0.0.0:PORT)."""
+
+    def __init__(self, store: Store, address: str = ":0"):
+        self.store = store
+        self._address = _parse_address(address, default_host="127.0.0.1")
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("gateway not started")
+        return self._httpd.server_address[1]
+
+    def start(self) -> "ApiGateway":
+        store = self.store
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, exc: Exception) -> None:
+                self._reply(code, {"error": str(exc),
+                                   "type": type(exc).__name__})
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def _route(self):
+                """(verb-agnostic) path -> (segments, query dict). Blank
+                values are KEPT: list?namespace= means namespace "" (the
+                Store.list semantic), not namespace-absent."""
+                parts = urlsplit(self.path)
+                segs = [s for s in parts.path.split("/") if s]
+                q = {k: v[0] for k, v in parse_qs(
+                    parts.query, keep_blank_values=True).items()}
+                return segs, q
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                segs, q = self._route()
+                try:
+                    if segs == ["healthz"]:
+                        self._reply(200, {"ok": True})
+                    elif len(segs) == 2 and segs[0] == "apis":
+                        ns = q.get("namespace")
+                        selector = None
+                        if q.get("selector"):
+                            selector = dict(
+                                kv.split("=", 1)
+                                for kv in q["selector"].split(","))
+                        items = store.list(segs[1], namespace=ns,
+                                           selector=selector)
+                        self._reply(200, {"items": [
+                            codec.envelope(o) for o in items]})
+                    elif len(segs) == 4 and segs[0] == "apis":
+                        ns = "" if segs[2] == "-" else segs[2]
+                        obj = store.get(segs[1], ns, segs[3])
+                        self._reply(200, codec.envelope(obj))
+                    elif len(segs) == 4 and segs[0] == "events":
+                        ns = "" if segs[2] == "-" else segs[2]
+                        obj = store.get(segs[1], ns, segs[3])
+                        self._reply(200, {"items": [
+                            {"event_type": e.event_type, "reason": e.reason,
+                             "message": e.message}
+                            for e in store.events_for(obj)]})
+                    else:
+                        self._reply(404, {"error": "not found"})
+                except NotFoundError as e:
+                    self._error(404, e)
+                except Exception as e:  # noqa: BLE001 — served boundary
+                    logger.exception("gateway GET %s failed", self.path)
+                    self._error(500, e)
+
+            def do_POST(self):  # noqa: N802
+                segs, _ = self._route()
+                try:
+                    if len(segs) == 2 and segs[0] == "apis":
+                        obj = codec.from_envelope(self._body())
+                        if type(obj).KIND != segs[1]:
+                            self._reply(400, {
+                                "error": f"kind mismatch: {type(obj).KIND}"
+                                         f" != {segs[1]}",
+                                "type": "ValueError"})
+                            return
+                        created = store.create(obj)
+                        self._reply(201, codec.envelope(created))
+                    else:
+                        self._reply(404, {"error": "not found"})
+                except AdmissionError as e:
+                    self._error(422, e)
+                except ConflictError as e:
+                    self._error(409, e)
+                except (ValueError, KeyError, json.JSONDecodeError) as e:
+                    self._error(400, e)  # malformed envelope: client error
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("gateway POST %s failed", self.path)
+                    self._error(500, e)
+
+            def do_PUT(self):  # noqa: N802
+                segs, q = self._route()
+                try:
+                    if len(segs) == 4 and segs[0] == "apis":
+                        obj = codec.from_envelope(self._body())
+                        expect = (int(q["expect"])
+                                  if "expect" in q else None)
+                        updated = store.update(obj, expect_version=expect)
+                        self._reply(200, codec.envelope(updated))
+                    else:
+                        self._reply(404, {"error": "not found"})
+                except NotFoundError as e:
+                    self._error(404, e)
+                except ConflictError as e:
+                    self._error(409, e)
+                except (ValueError, KeyError, json.JSONDecodeError) as e:
+                    self._error(400, e)  # bad expect=/envelope: client error
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("gateway PUT %s failed", self.path)
+                    self._error(500, e)
+
+            def do_DELETE(self):  # noqa: N802
+                segs, _ = self._route()
+                try:
+                    if len(segs) == 4 and segs[0] == "apis":
+                        ns = "" if segs[2] == "-" else segs[2]
+                        obj = store.delete(segs[1], ns, segs[3])
+                        self._reply(200, codec.envelope(obj))
+                    else:
+                        self._reply(404, {"error": "not found"})
+                except NotFoundError as e:
+                    self._error(404, e)
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("gateway DELETE %s failed", self.path)
+                    self._error(500, e)
+
+            def log_message(self, fmt, *args):
+                logger.debug("gateway: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer(self._address, Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="volcano-api-gateway")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,151 @@
+"""RemoteStore — HTTP client twin of the in-process Store.
+
+Implements the read/write verbs the CLI layers use (create / update /
+delete / get / list / events_for) against a store gateway
+(store/gateway.py), so ``cli/job.py`` and ``cli/queue.py`` drive a LIVE
+cluster process unchanged — the networked counterpart of the reference's
+vcctl-to-API-server client (cmd/cli/vcctl.go:34; pkg/cli/job/run.go:55-80).
+
+Errors map back to the store's exception types (NotFoundError /
+ConflictError / AdmissionError), so callers cannot tell the difference.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+from volcano_tpu.api import codec
+from volcano_tpu.store.store import (
+    CLUSTER_SCOPED, AdmissionError, ConflictError, NotFoundError)
+
+CLUSTER_SCOPED_PLACEHOLDER = "-"
+
+
+class RemoteStoreError(RuntimeError):
+    pass
+
+
+class RemoteEvent:
+    """Duck-typed event entry (store.RecordedEvent contract subset)."""
+
+    __slots__ = ("event_type", "reason", "message")
+
+    def __init__(self, event_type: str, reason: str, message: str):
+        self.event_type = event_type
+        self.reason = reason
+        self.message = message
+
+
+class RemoteStore:
+    def __init__(self, server: str, timeout: float = 10.0):
+        if "://" not in server:
+            server = "http://" + server
+        self.base = server.rstrip("/")
+        self.timeout = timeout
+
+    # -- transport ---------------------------------------------------------
+
+    def _request(self, method: str, path: str, payload: Optional[dict] = None,
+                 query: Optional[Dict[str, str]] = None) -> dict:
+        url = self.base + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read() or b"{}")
+            except Exception:
+                detail = {}
+            msg = detail.get("error", str(e))
+            if e.code == 400:
+                raise ValueError(msg) from None
+            if e.code == 404:
+                raise NotFoundError(msg) from None
+            if e.code == 409:
+                raise ConflictError(msg) from None
+            if e.code == 422:
+                raise AdmissionError(msg) from None
+            raise RemoteStoreError(f"{method} {url}: {e.code} {msg}") from None
+        except urllib.error.URLError as e:
+            raise RemoteStoreError(f"{method} {url}: {e.reason}") from None
+
+    @staticmethod
+    def _ns_seg(namespace: str) -> str:
+        return namespace or CLUSTER_SCOPED_PLACEHOLDER
+
+    # -- verbs (Store surface subset) ---------------------------------------
+
+    def create(self, obj) -> object:
+        kind = type(obj).KIND
+        out = self._request("POST", f"/apis/{kind}", codec.envelope(obj))
+        return codec.from_envelope(out)
+
+    def update(self, obj, expect_version: Optional[int] = None) -> object:
+        kind = type(obj).KIND
+        ns = self._ns_seg(
+            "" if kind in CLUSTER_SCOPED else obj.metadata.namespace)
+        q = {"expect": str(expect_version)} if expect_version is not None else None
+        out = self._request(
+            "PUT", f"/apis/{kind}/{ns}/{obj.metadata.name}",
+            codec.envelope(obj), q)
+        return codec.from_envelope(out)
+
+    def update_status(self, obj) -> object:
+        return self.update(obj)
+
+    def delete(self, kind: str, namespace: str, name: str) -> object:
+        out = self._request(
+            "DELETE", f"/apis/{kind}/{self._ns_seg(namespace)}/{name}")
+        return codec.from_envelope(out)
+
+    def try_delete(self, kind: str, namespace: str, name: str):
+        try:
+            return self.delete(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def get(self, kind: str, namespace: str, name: str) -> object:
+        out = self._request(
+            "GET", f"/apis/{kind}/{self._ns_seg(namespace)}/{name}")
+        return codec.from_envelope(out)
+
+    def try_get(self, kind: str, namespace: str, name: str):
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             selector: Optional[Dict[str, str]] = None) -> List[object]:
+        q: Dict[str, str] = {}
+        if namespace is not None:
+            q["namespace"] = namespace
+        if selector:
+            q["selector"] = ",".join(f"{k}={v}" for k, v in selector.items())
+        out = self._request("GET", f"/apis/{kind}", query=q or None)
+        return [codec.from_envelope(item) for item in out.get("items", [])]
+
+    def events_for(self, obj) -> list:
+        kind = type(obj).KIND
+        ns = self._ns_seg(
+            "" if kind in CLUSTER_SCOPED else obj.metadata.namespace)
+        out = self._request(
+            "GET", f"/events/{kind}/{ns}/{obj.metadata.name}")
+        return [RemoteEvent(i["event_type"], i["reason"], i["message"])
+                for i in out.get("items", [])]
+
+    def healthy(self) -> bool:
+        try:
+            return bool(self._request("GET", "/healthz").get("ok"))
+        except Exception:
+            return False

@@ -1,0 +1,184 @@
+"""StoreVolumeBinder: PV assume/bind semantics at binding time
+(the reference's defaultVolumeBinder wraps the k8s volumebinder,
+pkg/scheduler/cache/cache.go:240-258 — assume on allocate, bind on
+commit, placement fails when no compatible volume exists)."""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.helpers import close_session, make_tiers, open_session
+from volcano_tpu.api import objects
+from volcano_tpu.scheduler.cache.cache import SchedulerCache, StoreVolumeBinder
+from volcano_tpu.scheduler.framework import get_action
+from volcano_tpu.scheduler.util.test_utils import (
+    FakeBinder, FakeEvictor, FakeStatusUpdater,
+    build_node, build_pod, build_pod_group, build_queue,
+    build_resource_list_with_pods,
+)
+from volcano_tpu.store.store import Store
+
+TIERS = (["priority", "gang"], ["drf", "predicates", "proportion", "nodeorder"])
+
+
+def _pv(name, storage="10Gi", node_names=()):
+    return objects.PersistentVolume(
+        metadata=objects.ObjectMeta(name=name),
+        capacity={"storage": storage}, node_names=list(node_names))
+
+
+def _pvc(ns, name, storage="5Gi"):
+    return objects.PersistentVolumeClaim(
+        metadata=objects.ObjectMeta(name=name, namespace=ns),
+        requests={"storage": storage})
+
+
+def _cluster(nodes=2):
+    store = Store()
+    cache = SchedulerCache(
+        store=store, binder=FakeBinder(), evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater())  # volume binder defaults: store
+    cache.run()
+    store.create(build_queue("default"))
+    for i in range(nodes):
+        store.create(build_node(
+            f"n{i}", build_resource_list_with_pods("8", "16Gi")))
+    assert isinstance(cache.volume_binder, StoreVolumeBinder)
+    return store, cache
+
+
+def _pod_with_pvc(ns, name, pvc_name, group):
+    pod = build_pod(ns, name, "", "Pending", {"cpu": "1"}, group)
+    pod.spec.volumes.append(objects.Volume(
+        name="data", persistent_volume_claim=pvc_name))
+    return pod
+
+
+def _schedule(cache):
+    ssn = open_session(cache, make_tiers(*TIERS))
+    for action in ("enqueue", "allocate", "backfill"):
+        get_action(action).execute(ssn)
+    close_session(ssn)
+
+
+def test_assume_and_bind_commits_pv_pvc():
+    store, cache = _cluster()
+    store.create(_pv("pv-a", "10Gi"))
+    store.create(_pvc("default", "claim-a"))
+    store.create(build_pod_group("pg", min_member=1))
+    store.create(_pod_with_pvc("default", "p0", "claim-a", "pg"))
+
+    _schedule(cache)
+    assert len(cache.binder.binds) == 1
+    pv = store.get("PersistentVolume", "", "pv-a")
+    pvc = store.get("PersistentVolumeClaim", "default", "claim-a")
+    assert pv.phase == "Bound" and pv.claim_ref == "default/claim-a"
+    assert pvc.phase == "Bound" and pvc.volume_name == "pv-a"
+
+
+def test_local_volume_constrains_host():
+    """A node-local PV: binding succeeds only when the chosen host carries
+    the volume; a host mismatch fails the allocation (assume failure)."""
+    store, cache = _cluster(nodes=3)
+    store.create(_pv("pv-local", "10Gi", node_names=["n1"]))
+    store.create(_pvc("default", "claim-l"))
+    store.create(build_pod_group("pg", min_member=1))
+    store.create(_pod_with_pvc("default", "p0", "claim-l", "pg"))
+
+    _schedule(cache)
+    binds = cache.binder.binds
+    if binds:  # bound => it MUST be the volume's node
+        assert binds["default/p0"] == "n1", binds
+        assert store.get("PersistentVolume", "", "pv-local").phase == "Bound"
+    else:  # chosen host mismatched: allocation failed, nothing half-bound
+        assert store.get("PersistentVolume", "", "pv-local").phase == "Available"
+        pvc = store.get("PersistentVolumeClaim", "default", "claim-l")
+        assert pvc.phase == "Pending"
+
+
+def test_smallest_sufficient_volume_wins():
+    store, cache = _cluster()
+    store.create(_pv("pv-big", "100Gi"))
+    store.create(_pv("pv-small", "6Gi"))
+    store.create(_pvc("default", "claim-s", "5Gi"))
+    store.create(build_pod_group("pg", min_member=1))
+    store.create(_pod_with_pvc("default", "p0", "claim-s", "pg"))
+
+    _schedule(cache)
+    assert len(cache.binder.binds) == 1
+    assert store.get("PersistentVolumeClaim",
+                     "default", "claim-s").volume_name == "pv-small"
+    assert store.get("PersistentVolume", "", "pv-big").phase == "Available"
+
+
+def test_no_fitting_volume_blocks_placement():
+    store, cache = _cluster()
+    store.create(_pv("pv-tiny", "1Gi"))
+    store.create(_pvc("default", "claim-x", "50Gi"))
+    store.create(build_pod_group("pg", min_member=1))
+    store.create(_pod_with_pvc("default", "p0", "claim-x", "pg"))
+
+    _schedule(cache)
+    assert "default/p0" not in cache.binder.binds
+    assert store.get("PersistentVolume", "", "pv-tiny").phase == "Available"
+
+
+def test_two_claims_cannot_share_one_volume():
+    store, cache = _cluster()
+    store.create(_pv("pv-only", "10Gi"))
+    store.create(_pvc("default", "claim-1"))
+    store.create(_pvc("default", "claim-2"))
+    store.create(build_pod_group("pg", min_member=1))
+    store.create(_pod_with_pvc("default", "p1", "claim-1", "pg"))
+    store.create(_pod_with_pvc("default", "p2", "claim-2", "pg"))
+
+    _schedule(cache)
+    bound = [k for k in cache.binder.binds]
+    assert len(bound) == 1, bound  # exactly one pod got the volume
+    pv = store.get("PersistentVolume", "", "pv-only")
+    assert pv.phase == "Bound"
+
+
+def test_pvc_pods_take_residue_under_rounds_mode():
+    """PVC-referencing pods are excluded from the device bulk solve (the
+    volume assume is live per-host logic) and placed by the serial residue
+    pass — same session, volumes bound, plain pods still bulk-placed."""
+    from tests.helpers import make_tiers as mk
+
+    store, cache = _cluster(nodes=3)
+    store.create(_pv("pv-r", "10Gi"))
+    store.create(_pvc("default", "claim-r"))
+    store.create(build_pod_group("pg", min_member=1))
+    store.create(_pod_with_pvc("default", "pv-pod", "claim-r", "pg"))
+    for i in range(6):
+        store.create(build_pod("default", f"plain-{i}", "", "Pending",
+                               {"cpu": "1"}, "pg"))
+
+    ssn = open_session(cache, mk(["tpuscore"], *TIERS))
+    assert ssn.batch_allocator is not None
+    ssn.batch_allocator.mode = "rounds"
+    for action in ("enqueue", "allocate", "backfill"):
+        get_action(action).execute(ssn)
+    prof = dict(ssn.plugins["tpuscore"].profile)
+    close_session(ssn)
+    assert prof.get("mode") == "rounds", prof
+    assert prof.get("residue", 0) >= 1, prof  # the PVC pod went serial
+    assert len(cache.binder.binds) == 7, cache.binder.binds
+    assert store.get("PersistentVolume", "", "pv-r").phase == "Bound"
+
+
+def test_pvc_free_sessions_keep_native_bulk_path():
+    """The PVC-pod counter gates the per-task volume calls: with a real
+    StoreVolumeBinder but no PVC pods, the bulk writeback must stay
+    eligible for the native loop (vols_noop)."""
+    store, cache = _cluster()
+    store.create(build_pod_group("pg", min_member=2))
+    for i in range(2):
+        store.create(build_pod("default", f"p{i}", "", "Pending",
+                               {"cpu": "1"}, "pg"))
+    assert cache._pvc_pod_count == 0
+    store.create(_pvc("default", "c"))
+    store.create(_pod_with_pvc("default", "pv-pod", "c", "pg"))
+    assert cache._pvc_pod_count == 1
+    store.delete("Pod", "default", "pv-pod")
+    assert cache._pvc_pod_count == 0

@@ -1703,6 +1703,101 @@ TransCtx_mirror_bind(TransCtx *self, PyObject *args)
     return out;
 }
 
+/* ------------------------------------------------------------------ */
+/* module-level: candidate-stream head pick                            */
+/* ------------------------------------------------------------------ */
+
+/* pick_first(idx_i64, row_f64, rr, num_to_find, n) -> (best_pos, processed)
+ *
+ * The head of DensePreemptView.candidates' stream (preempt/reclaim
+ * consume exactly one element in practice): over the round-robin window
+ * of the sorted eligible-node index array `idx` (same arithmetic as the
+ * Python path — split at the cursor, take num_to_find circularly, else
+ * the full circle), return the POSITION IN idx of the first maximum of
+ * row[idx[...]] in window order (== head of the stable descending sort)
+ * and the cursor advance. Pure C twin of candidates()'s selection math;
+ * the Python generator remains the oracle and the continuation path. */
+static PyObject *
+fasttrans_pick_first(PyObject *self, PyObject *args)
+{
+    PyObject *idx_obj, *row_obj;
+    long long rr, ntf, n;
+    if (!PyArg_ParseTuple(args, "OOLLL", &idx_obj, &row_obj, &rr, &ntf, &n))
+        return NULL;
+    Py_buffer idx_buf, row_buf;
+    if (PyObject_GetBuffer(idx_obj, &idx_buf, PyBUF_CONTIG_RO) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(row_obj, &row_buf, PyBUF_CONTIG_RO) < 0) {
+        PyBuffer_Release(&idx_buf);
+        return NULL;
+    }
+    if (idx_buf.itemsize != 8 || row_buf.itemsize != 8) {
+        PyBuffer_Release(&idx_buf);
+        PyBuffer_Release(&row_buf);
+        PyErr_SetString(PyExc_TypeError,
+                        "pick_first: expected int64 idx and float64 row");
+        return NULL;
+    }
+    const long long *idx = (const long long *)idx_buf.buf;
+    const double *row = (const double *)row_buf.buf;
+    Py_ssize_t ft = idx_buf.len / 8;
+    long long processed;
+    Py_ssize_t best_pos = -1;
+    double best = 0.0;
+    if (ft == 0) {
+        processed = 0;
+    } else {
+        /* split = lower_bound(idx, rr) */
+        Py_ssize_t lo = 0, hi = ft;
+        while (lo < hi) {
+            Py_ssize_t mid = (lo + hi) / 2;
+            if (idx[mid] < rr)
+                lo = mid + 1;
+            else
+                hi = mid;
+        }
+        Py_ssize_t split = lo;
+        Py_ssize_t take_tail, wrap;
+        if (ft >= ntf) {
+            take_tail = ft - split < ntf ? ft - split : (Py_ssize_t)ntf;
+            wrap = (Py_ssize_t)ntf - take_tail;
+            long long last = wrap > 0 ? idx[wrap - 1]
+                                      : idx[split + take_tail - 1];
+            processed = ((last - rr) % n + n) % n + 1;
+        } else {
+            take_tail = ft - split;
+            wrap = split;
+            processed = n;
+        }
+        /* first max in WINDOW order (== stable descending-sort head);
+         * best_pos < 0 seeds in BOTH loops — an all-wrap window (cursor
+         * past every eligible index) with non-positive scores must still
+         * yield its first element, exactly as np.argmax does */
+        for (Py_ssize_t i = 0; i < take_tail; i++) {
+            double s = row[idx[split + i]];
+            if (best_pos < 0 || s > best) {
+                best = s;
+                best_pos = split + i;
+            }
+        }
+        for (Py_ssize_t i = 0; i < wrap; i++) {
+            double s = row[idx[i]];
+            if (best_pos < 0 || s > best) {
+                best = s;
+                best_pos = i;
+            }
+        }
+    }
+    PyBuffer_Release(&idx_buf);
+    PyBuffer_Release(&row_buf);
+    return Py_BuildValue("nL", best_pos, processed);
+}
+
+static PyMethodDef fasttrans_functions[] = {
+    {"pick_first", fasttrans_pick_first, METH_VARARGS, NULL},
+    {NULL, NULL, 0, NULL},
+};
+
 static PyMethodDef TransCtx_methods[] = {
     {"evict", (PyCFunction)TransCtx_evict, METH_VARARGS, NULL},
     {"pipeline", (PyCFunction)TransCtx_pipeline, METH_VARARGS, NULL},
@@ -1727,7 +1822,7 @@ static PyTypeObject TransCtxType = {
 
 static struct PyModuleDef fasttrans_module = {
     PyModuleDef_HEAD_INIT, "_fasttrans",
-    "native per-operation transition engine", -1, NULL,
+    "native per-operation transition engine", -1, fasttrans_functions,
 };
 
 PyMODINIT_FUNC

@@ -475,8 +475,27 @@ class PersistentVolumeClaim:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     requests: Dict[str, object] = field(default_factory=dict)
     phase: str = "Pending"
+    volume_name: str = ""  # bound PV (set by the volume binder)
 
     KIND = "PersistentVolumeClaim"
+
+
+@dataclass
+class PersistentVolume:
+    """Cluster-scoped storage the volume binder assumes/binds PVCs
+    against (the reference binds through the k8s volumebinder —
+    pkg/scheduler/cache/cache.go:240-258; this is the store-native
+    equivalent). Empty ``node_names`` means host-agnostic storage;
+    otherwise the volume is local to those nodes and constrains
+    placement at binding time."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    capacity: Dict[str, object] = field(default_factory=dict)  # {"storage": "10Gi"}
+    node_names: List[str] = field(default_factory=list)
+    claim_ref: str = ""  # "namespace/name" of the bound PVC
+    phase: str = "Available"  # Available | Bound
+
+    KIND = "PersistentVolume"
 
 
 @dataclass

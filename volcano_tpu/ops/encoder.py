@@ -313,7 +313,7 @@ def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue,
     session-signature NUMBERING differs (table-id order instead of
     first-encounter order), which nothing downstream depends on."""
     from volcano_tpu.scheduler.cache.podtable import (
-        FLAG_AFFINITY, FLAG_PORTS, FLAG_REQ_EMPTY)
+        FLAG_AFFINITY, FLAG_PORTS, FLAG_PVC, FLAG_REQ_EMPTY)
 
     from itertools import chain
 
@@ -382,7 +382,7 @@ def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue,
         (uid[sub], g["ctime"][sub], -prio[sub], job_of_arr[sub]))
     sel = sub[order]  # indices into all_tasks, job-major sorted
 
-    residue = ((flags & (FLAG_PORTS | FLAG_AFFINITY)) != 0)[sel]
+    residue = ((flags & (FLAG_PORTS | FLAG_AFFINITY | FLAG_PVC)) != 0)[sel]
     task_excl = None
     excl_occ_rows: list = []
     if residue.any():
@@ -399,9 +399,9 @@ def _fast_task_axis(jobs, j_count, nodes, table, prio_on, allow_residue,
         # residue (FLAG_PORTS also set => stays residue: ports are live-
         # checked only serially)
         aff_only = ((flags[sel] & FLAG_AFFINITY) != 0) & \
-            ((flags[sel] & FLAG_PORTS) == 0) & residue
+            ((flags[sel] & (FLAG_PORTS | FLAG_PVC)) == 0) & residue
         ports_only = ((flags[sel] & FLAG_PORTS) != 0) & \
-            ((flags[sel] & FLAG_AFFINITY) == 0) & residue
+            ((flags[sel] & (FLAG_AFFINITY | FLAG_PVC)) == 0) & residue
         cand_idx = [int(sel[i]) for i in np.nonzero(aff_only)[0]]
         port_idx = [int(sel[i]) for i in np.nonzero(ports_only)[0]]
         keep_plain = [int(sel[i]) for i in np.nonzero(~residue)[0]]
@@ -708,6 +708,14 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
                 if ports:
                     if not allow_residue:
                         raise EncoderFallback("host ports not modeled")
+                    job_residue[ji] += 1
+                    continue
+                if any(v.persistent_volume_claim
+                       for v in t.pod.spec.volumes):
+                    # volume assume/bind is live per-host logic
+                    # (StoreVolumeBinder); the serial pass owns it
+                    if not allow_residue:
+                        raise EncoderFallback("pod volumes not modeled")
                     job_residue[ji] += 1
                     continue
                 if sym_active:

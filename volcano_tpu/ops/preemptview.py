@@ -88,6 +88,27 @@ class _Unsupported(Exception):
     pass
 
 
+def _window_sel(idx: np.ndarray, rr: int, num_to_find: int, n: int):
+    """The round-robin sampling window over the sorted eligible-node index
+    array: (sel, processed) exactly as predicate_nodes' circular visit
+    computes it. ONE definition — the candidates() fast/fallback paths and
+    the C twin (fasttrans.c pick_first) all mirror this arithmetic."""
+    split = int(np.searchsorted(idx, rr))
+    found_total = idx.size
+    if found_total >= num_to_find:
+        # circular visit order: tail from split, then wrap; slicing views
+        # the cached array (no copy) in the common no-wrap case
+        take_tail = min(num_to_find, found_total - split)
+        sel = idx[split:split + take_tail]
+        if take_tail < num_to_find:
+            sel = np.concatenate([sel, idx[: num_to_find - take_tail]])
+        processed = (int(sel[-1]) - rr) % n + 1
+    else:
+        sel = np.concatenate([idx[split:], idx[:split]]) if split else idx
+        processed = n
+    return sel, processed
+
+
 class DensePreemptView:
     def __init__(self, ssn, for_allocate: bool = False):
         self.ssn = ssn
@@ -228,6 +249,13 @@ class DensePreemptView:
         # to ssn._placement_gen — equality proves every placement-shaped
         # mutation since build was routed through the hooks
         self._synced_gen = getattr(ssn, "_placement_gen", 0)
+        # native candidate-head pick (fasttrans.c pick_first); None keeps
+        # the pure-Python window selection
+        from volcano_tpu import _native
+
+        _mod = _native.get_fasttrans_nowait()
+        self._pick_first = getattr(_mod, "pick_first", None) \
+            if _mod is not None else None
         self._sig_mask: Dict[str, np.ndarray] = {}
         self._sig_aff: Dict[str, Optional[np.ndarray]] = {}
         self._node_idx = {name: i for i, name in enumerate(self.node_names)}
@@ -324,30 +352,39 @@ class DensePreemptView:
 
     # -- scoring (numpy mirror of kernels.fused_scores) --------------------
 
-    def _score_row(self, task, aff: Optional[np.ndarray],
-                   sel: np.ndarray) -> np.ndarray:
-        """Scores for the selected nodes, via the class's cached [N] row
-        when the class repeats (lazily replaying recomputes for nodes
-        touched by pipelines since last sync); one-off classes compute only
-        the window."""
+    def _row_key(self, task):
         res = task.resreq
-        key = (
+        return (
             enc_mod._pod_encode_traits(task.pod)[0] if task.pod is not None
             else "<none>",
             res.milli_cpu, res.memory,
             tuple(sorted((res.scalar_resources or {}).items())),
         )
+
+    def _score_row_full(self, task, aff: Optional[np.ndarray],
+                        key=None, register: bool = False
+                        ) -> Optional[np.ndarray]:
+        """The class's repaired FULL [N] score row, or None when the class
+        is not promoted to a cached row (first sighting / cache full).
+        ``register`` marks a first sighting as seen (promotion happens on
+        the SECOND sighting) — native-path PEEKS must leave it False, or a
+        probe would spend a promotion on a class the windowed path was
+        about to score once and never see again. Lazily replays recomputes
+        for nodes touched by pipelines since last sync; callers must treat
+        the row as read-only."""
+        if key is None:
+            key = self._row_key(task)
         cached = self._score_rows.get(key)
         touched = self._touched
         if cached is None:
             if (key not in self._seen_keys
                     or len(self._score_rows) >= self._SCORE_ROW_CAP):
-                # first sighting (or cache full): windowed compute only
-                self._seen_keys.add(key)
-                return self._scores(task, sel, aff)
+                if register:
+                    self._seen_keys.add(key)
+                return None
             row = self._scores(task, np.arange(self.n), aff)
             self._score_rows[key] = [row, len(touched)]
-            return row[sel]
+            return row
         row, sync = cached
         if sync < len(touched):
             stale = sorted(set(touched[sync:]))
@@ -360,6 +397,16 @@ class DensePreemptView:
                 stale_arr = np.asarray(stale, np.int64)
                 row[stale_arr] = self._scores(task, stale_arr, aff)
             cached[1] = len(touched)
+        return row
+
+    def _score_row(self, task, aff: Optional[np.ndarray],
+                   sel: np.ndarray) -> np.ndarray:
+        """Scores for the selected nodes, via the class's cached [N] row
+        when the class repeats; one-off classes compute only the window."""
+        key = self._row_key(task)
+        row = self._score_row_full(task, aff, key=key, register=True)
+        if row is None:
+            return self._scores(task, sel, aff)
         return row[sel]
 
     def _score_one(self, task, i: int, aff: Optional[np.ndarray]) -> float:
@@ -512,26 +559,42 @@ class DensePreemptView:
         # starts at nodes[cursor % n] — the window and the post-advance
         # cursor are identical either way (both arithmetics are mod n)
         rr = helper._last_processed_node_index % n
-        split = int(np.searchsorted(idx, rr))
-        found_total = idx.size
-        if found_total >= num_to_find:
-            # circular visit order: tail from split, then wrap; slicing
-            # views the cached array (no copy) in the common no-wrap case
-            take_tail = min(num_to_find, found_total - split)
-            sel = idx[split:split + take_tail]
-            if take_tail < num_to_find:
-                sel = np.concatenate([sel, idx[: num_to_find - take_tail]])
-            last = int(sel[-1])
-            processed = (last - rr) % n + 1
-        else:
-            sel = np.concatenate([idx[split:], idx[:split]]) if split else idx
-            processed = n
+        nodes = self.nodes
+
+        # native head pick (the depth-1 hot path): C computes the window
+        # and its first-max in one pass over the repaired full score row;
+        # the Python machinery below stays as the oracle, the no-row /
+        # no-native fallback, and the (rare) continuation. The PEEK must
+        # not register first sightings (see _score_row_full).
+        if self._pick_first is not None and idx.size:
+            row = self._score_row_full(task, aff)
+            if row is not None:
+                best_pos, processed = self._pick_first(
+                    idx, row, rr, num_to_find, n)
+                helper._last_processed_node_index = (rr + processed) % n
+                if best_pos < 0:
+                    return iter(())
+                head = nodes[int(idx[best_pos])]
+
+                def _stream_native():
+                    yield head
+                    # continuation: rebuild the exact remainder sequence
+                    sel, _ = _window_sel(idx, rr, num_to_find, n)
+                    scores = row[sel]
+                    first = int(np.argmax(scores))
+                    order = np.argsort(-scores, kind="stable")
+                    for p in order.tolist():
+                        if p != first:
+                            yield nodes[int(sel[p])]
+
+                return _stream_native()
+
+        sel, processed = _window_sel(idx, rr, num_to_find, n)
         helper._last_processed_node_index = (rr + processed) % n
 
         if sel.size == 0:
             return iter(())
         scores = self._score_row(task, aff, sel)
-        nodes = self.nodes
 
         def _stream():
             # consumers almost always stop at the first workable node, so

@@ -515,7 +515,12 @@ class BatchAllocator:
         ssn_nodes = ssn.nodes
         cache_nodes = cache.nodes
         vb = cache.volume_binder
-        vols_noop = getattr(vb, "IS_NOOP", False)
+        # volume calls are skippable when the binder is a declared no-op
+        # OR no pod in the cache references a PVC (counter maintained by
+        # the cache's task handlers) — a real StoreVolumeBinder then costs
+        # nothing on PVC-free sessions and the native loop stays eligible
+        vols_noop = getattr(vb, "IS_NOOP", False) or (
+            getattr(cache, "_pvc_pod_count", 1) == 0)
         alloc_vols = vb.allocate_volumes
         bind_vols = vb.bind_volumes
 

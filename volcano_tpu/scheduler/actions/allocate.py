@@ -63,7 +63,7 @@ class AllocateAction(Action):
         self._serial_execute(ssn)
 
     def _serial_execute(self, ssn, assist=None) -> None:
-        namespaces = PriorityQueue(ssn.namespace_order_fn)
+        namespaces = PriorityQueue(cmp_fn=ssn.namespace_order_cmp)
         # namespace -> queue -> job PQ
         jobs_map: Dict[str, Dict[str, PriorityQueue]] = {}
 
@@ -85,7 +85,7 @@ class AllocateAction(Action):
                 namespaces.push(job.namespace)
                 queue_map = jobs_map[job.namespace] = {}
             if job.queue not in queue_map:
-                queue_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+                queue_map[job.queue] = PriorityQueue(cmp_fn=ssn.job_order_cmp)
             queue_map[job.queue].push(job)
 
         pending_tasks: Dict[str, PriorityQueue] = {}

@@ -24,7 +24,7 @@ class EnqueueAction(Action):
         return "enqueue"
 
     def execute(self, ssn) -> None:
-        queues = PriorityQueue(ssn.queue_order_fn)
+        queues = PriorityQueue(cmp_fn=ssn.queue_order_cmp)
         queue_set = set()
         jobs_map: Dict[str, PriorityQueue] = {}
 
@@ -37,7 +37,7 @@ class EnqueueAction(Action):
                 queues.push(queue)
             if job.pod_group.status.phase == objects.PodGroupPhase.PENDING:
                 if job.queue not in jobs_map:
-                    jobs_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+                    jobs_map[job.queue] = PriorityQueue(cmp_fn=ssn.job_order_cmp)
                 jobs_map[job.queue].push(job)
 
         empty = Resource.empty()

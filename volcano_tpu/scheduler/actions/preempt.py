@@ -12,7 +12,8 @@ import logging
 from typing import Dict, List
 
 from volcano_tpu.api import objects
-from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.resource import (
+    MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_SCALAR, Resource)
 from volcano_tpu.api.types import TaskStatus
 from volcano_tpu.scheduler import metrics
 from volcano_tpu.scheduler.framework.interface import Action
@@ -59,7 +60,7 @@ class PreemptAction(Action):
 
             if job.task_status_index.get(TaskStatus.PENDING):
                 if job.queue not in preemptors_map:
-                    preemptors_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+                    preemptors_map[job.queue] = PriorityQueue(cmp_fn=ssn.job_order_cmp)
                 preemptors_map[job.queue].push(job)
                 under_request.append(job)
                 preemptor_tasks[job.uid] = make_task_queue(
@@ -157,6 +158,15 @@ def _preempt(ssn, stmt, preemptor, nodes, task_filter, view=None,
             ssn.batch_node_order_fn, ssn.node_order_map_fn, ssn.node_order_reduce_fn)
         candidates = helper.sort_nodes(node_scores)
 
+    # scalar-free requests (the overwhelmingly common case) take a pure
+    # float cut below: the accumulate/epsilon-compare sequence is
+    # arithmetic-identical to Resource.add + less_equal, minus the object
+    # churn per victim — any scalar on either side restores the oracle
+    init_req = preemptor.init_resreq
+    init_scalars = init_req.scalar_resources
+    fast_req = init_scalars is None or not any(
+        v > MIN_MILLI_SCALAR for v in init_scalars.values())
+
     for node in candidates:
         # shared_clone: victims need independent status words for the
         # evict bookkeeping but never mutate their request Resources
@@ -173,8 +183,12 @@ def _preempt(ssn, stmt, preemptor, nodes, task_filter, view=None,
         if not _validate_victims(victims, preemptor.init_resreq):
             continue
 
+        fast = fast_req and not any(v.resreq.scalar_resources
+                                    for v in victims)
         preempted = Resource.empty()
-        resreq = preemptor.init_resreq.clone()
+        resreq = None if fast else preemptor.init_resreq.clone()
+        need_cpu, need_mem = init_req.milli_cpu, init_req.memory
+        got_cpu = got_mem = 0.0
 
         # lowest-priority victims first (inverse task order)
         victims_queue = make_task_queue(ssn, victims, reverse=True)
@@ -187,13 +201,30 @@ def _preempt(ssn, stmt, preemptor, nodes, task_filter, view=None,
                              preemptee.namespace, preemptee.name,
                              preemptor.namespace, preemptor.name, e)
                 continue
-            preempted.add(preemptee.resreq)
-            if resreq.less_equal(preempted):
-                break
+            if fast:
+                vr = preemptee.resreq
+                got_cpu += vr.milli_cpu
+                got_mem += vr.memory
+                if (need_cpu < got_cpu or abs(need_cpu - got_cpu)
+                        < MIN_MILLI_CPU) and \
+                   (need_mem < got_mem or abs(need_mem - got_mem)
+                        < MIN_MEMORY):
+                    break
+            else:
+                preempted.add(preemptee.resreq)
+                if resreq.less_equal(preempted):
+                    break
 
         metrics.register_preemption_attempts()
 
-        if preemptor.init_resreq.less_equal(preempted):
+        if fast:
+            covered = (need_cpu < got_cpu or abs(need_cpu - got_cpu)
+                       < MIN_MILLI_CPU) and \
+                      (need_mem < got_mem or abs(need_mem - got_mem)
+                       < MIN_MEMORY)
+        else:
+            covered = preemptor.init_resreq.less_equal(preempted)
+        if covered:
             stmt.pipeline(preemptor, node.name)
             if fell_back and view is not None and view.needs_poison(preemptor):
                 # pipeline fires allocate events IMMEDIATELY (statement.py),

@@ -41,7 +41,7 @@ class ReclaimAction(Action):
         selector = victimview.build(ssn, "reclaimable") \
             if view is not None else None
 
-        queues = PriorityQueue(ssn.queue_order_fn)
+        queues = PriorityQueue(cmp_fn=ssn.queue_order_cmp)
         queue_set = set()
         preemptors_map: Dict[str, PriorityQueue] = {}
         preemptor_tasks: Dict[str, object] = {}
@@ -60,7 +60,7 @@ class ReclaimAction(Action):
                 queues.push(queue)
             if job.task_status_index.get(TaskStatus.PENDING):
                 if job.queue not in preemptors_map:
-                    preemptors_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+                    preemptors_map[job.queue] = PriorityQueue(cmp_fn=ssn.job_order_cmp)
                 preemptors_map[job.queue].push(job)
                 preemptor_tasks[job.uid] = make_task_queue(
                     ssn, job.task_status_index[TaskStatus.PENDING].values())

@@ -111,13 +111,120 @@ class DefaultStatusUpdater:
 
 
 class DefaultVolumeBinder:
-    """PVC assume/bind analog; volumes are considered host-agnostic here."""
+    """Storeless stand-in: volumes are considered host-agnostic. IS_NOOP
+    lets the bulk writeback skip per-task volume calls entirely."""
+
+    IS_NOOP = True
 
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
         task.volume_ready = True
 
     def bind_volumes(self, task: TaskInfo) -> None:
         pass
+
+
+class StoreVolumeBinder:
+    """PV assume/bind against real PersistentVolume objects — the analog
+    of the reference's defaultVolumeBinder wrapping the k8s volumebinder
+    (cache.go:240-258): AllocateVolumes ASSUMES a compatible volume for
+    each unbound PVC the pod references on the chosen host (raising fails
+    the allocation, exactly as an assume failure does), BindVolumes
+    commits the assumption (PV/PVC flip to Bound in the store)."""
+
+    def __init__(self, store: Store):
+        self.store = store
+        # task uid -> [(pvc, pv)] assumed but not yet bound
+        self._assumed: Dict[str, list] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _pvc_names(task: TaskInfo) -> list:
+        pod = task.pod
+        if pod is None:
+            return []
+        return [v.persistent_volume_claim for v in pod.spec.volumes
+                if v.persistent_volume_claim]
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        names = self._pvc_names(task)
+        if not names:
+            task.volume_ready = True
+            return
+        from volcano_tpu.api.quantity import parse_quantity
+
+        assumed = []
+        with self._lock:
+            taken = {pv.metadata.name for lst in self._assumed.values()
+                     for _, pv in lst}
+            for name in names:
+                pvc = self.store.try_get(
+                    "PersistentVolumeClaim", task.namespace, name)
+                if pvc is None:
+                    raise RuntimeError(
+                        f"pvc {task.namespace}/{name} not found")
+                if pvc.phase == "Bound":
+                    # a bound volume constrains placement: the host must
+                    # satisfy the volume's node affinity
+                    pv = self.store.try_get(
+                        "PersistentVolume", "", pvc.volume_name)
+                    if pv is not None and pv.node_names \
+                            and hostname not in pv.node_names:
+                        raise RuntimeError(
+                            f"pvc {task.namespace}/{name} is bound to "
+                            f"volume {pv.metadata.name} not reachable from "
+                            f"{hostname}")
+                    continue
+                want = parse_quantity(pvc.requests.get("storage", 0))
+                best = None
+                for pv in self.store.list("PersistentVolume"):
+                    if pv.phase != "Available" or pv.claim_ref:
+                        continue
+                    if pv.metadata.name in taken:
+                        continue
+                    if pv.node_names and hostname not in pv.node_names:
+                        continue
+                    have = parse_quantity(pv.capacity.get("storage", 0))
+                    if have < want:
+                        continue
+                    # smallest sufficient volume, name tie-break — the
+                    # k8s binder's smallest-fit policy, deterministic
+                    key = (have, pv.metadata.name)
+                    if best is None or key < (best[0], best[1].metadata.name):
+                        best = (have, pv)
+                if best is None:
+                    raise RuntimeError(
+                        f"no PersistentVolume fits pvc "
+                        f"{task.namespace}/{name} on {hostname}")
+                taken.add(best[1].metadata.name)
+                assumed.append((pvc, best[1]))
+            if assumed:
+                self._assumed.setdefault(task.uid, []).extend(assumed)
+        task.volume_ready = True
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        with self._lock:
+            assumed = self._assumed.pop(task.uid, [])
+        for pvc, pv in assumed:
+            pv.claim_ref = f"{pvc.metadata.namespace}/{pvc.metadata.name}"
+            pv.phase = "Bound"
+            pvc.phase = "Bound"
+            pvc.volume_name = pv.metadata.name
+            self.store.update_status(pv)
+            self.store.update_status(pvc)
+
+    def unassume(self, task: TaskInfo) -> None:
+        """Release assumptions for a task whose placement was discarded
+        (statement rollback); bound volumes are untouched."""
+        with self._lock:
+            self._assumed.pop(task.uid, None)
+
+    def reset_assumptions(self) -> None:
+        """Session close: drop every unbound assumption — assume/bind
+        always completes within one session (dispatch or statement
+        commit), so leftovers belong to placements that never dispatched
+        and would otherwise pin their PVs forever."""
+        with self._lock:
+            self._assumed.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -145,7 +252,9 @@ class SchedulerCache:
         self.status_updater = (
             status_updater if status_updater is not None else (DefaultStatusUpdater(store) if store else None)
         )
-        self.volume_binder = volume_binder if volume_binder is not None else DefaultVolumeBinder()
+        self.volume_binder = (
+            volume_binder if volume_binder is not None
+            else (StoreVolumeBinder(store) if store else DefaultVolumeBinder()))
 
         from volcano_tpu.scheduler.cache.podtable import PodTable
 
@@ -158,6 +267,9 @@ class SchedulerCache:
         self.namespace_collection: Dict[str, NamespaceCollection] = {}
 
         self._lock = threading.RLock()
+        # pods referencing PVCs (bulk-apply volume-call gate: a session
+        # with none skips per-task volume work entirely)
+        self._pvc_pod_count = 0
         self._err_tasks: List[TaskInfo] = []
         self._deleted_jobs: List[JobInfo] = []
         # native mirror-transition ctx for the effector path (built lazily;
@@ -207,6 +319,9 @@ class SchedulerCache:
         job = self._get_or_create_job(ti)
         if job is not None:
             job.add_task_info(ti)
+        if ti.pod is not None and any(
+                v.persistent_volume_claim for v in ti.pod.spec.volumes):
+            self._pvc_pod_count += 1
         if ti.pod is not None:
             # columnar mirror row (podtable.py): the encoder gathers dense
             # arrays instead of walking 50k task objects per session
@@ -218,6 +333,9 @@ class SchedulerCache:
                 self.nodes[ti.node_name].add_task(ti)
 
     def _delete_task(self, ti: TaskInfo) -> None:
+        if ti.pod is not None and any(
+                v.persistent_volume_claim for v in ti.pod.spec.volumes):
+            self._pvc_pod_count = max(0, self._pvc_pod_count - 1)
         self.pod_table.remove(ti.uid)
         errs = []
         if ti.job:

@@ -31,6 +31,9 @@ from volcano_tpu.api.pod_traits import pod_encode_traits
 FLAG_PORTS = np.uint8(1)
 FLAG_AFFINITY = np.uint8(2)
 FLAG_REQ_EMPTY = np.uint8(4)
+# references a PersistentVolumeClaim: volume assume/bind (StoreVolumeBinder)
+# is live per-host logic the bulk solve does not model -> serial residue
+FLAG_PVC = np.uint8(8)
 
 
 class PodTable:
@@ -114,6 +117,8 @@ class PodTable:
                 flags |= FLAG_AFFINITY
             if req.is_empty():
                 flags |= FLAG_REQ_EMPTY
+            if any(v.persistent_volume_claim for v in pod.spec.volumes):
+                flags |= FLAG_PVC
             self.flags[row] = flags
             sid = self._sig_ids.get(key)
             if sid is None:

@@ -52,6 +52,14 @@ def close_session(ssn: Session) -> None:
     flush = getattr(ssn.cache, "flush_mirror", None)
     if flush is not None:
         flush()
+    # volume assumptions not bound by session end belong to placements
+    # that never dispatched (e.g. a gang that stayed short) — release
+    # them, or their PVs stay unselectable forever (assume/bind always
+    # completes within one session; see StoreVolumeBinder)
+    vb = getattr(ssn.cache, "volume_binder", None)
+    reset_assumed = getattr(vb, "reset_assumptions", None)
+    if reset_assumed is not None:
+        reset_assumed()
     for plugin in ssn.plugins.values():
         start = time.perf_counter()
         plugin.on_session_close(ssn)

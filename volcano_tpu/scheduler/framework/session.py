@@ -280,11 +280,28 @@ class Session:
             return l.uid < r.uid
         return l.creation_timestamp < r.creation_timestamp
 
+    def job_order_cmp(self, l: JobInfo, r: JobInfo) -> int:
+        """3-way twin of job_order_fn (cmp < 0 iff job_order_fn(l, r)):
+        comparator heaps dispatch ONCE per comparison instead of probing
+        both directions for equality."""
+        j = self._order("enabled_job_order", self.job_order_fns, l, r)
+        if j != 0:
+            return j
+        if l.creation_timestamp == r.creation_timestamp:
+            return -1 if l.uid < r.uid else (1 if l.uid > r.uid else 0)
+        return -1 if l.creation_timestamp < r.creation_timestamp else 1
+
     def namespace_order_fn(self, l: str, r: str) -> bool:
         j = self._order("enabled_namespace_order", self.namespace_order_fns, l, r)
         if j != 0:
             return j < 0
         return l < r
+
+    def namespace_order_cmp(self, l: str, r: str) -> int:
+        j = self._order("enabled_namespace_order", self.namespace_order_fns, l, r)
+        if j != 0:
+            return j
+        return -1 if l < r else (1 if l > r else 0)
 
     def queue_order_fn(self, l: QueueInfo, r: QueueInfo) -> bool:
         j = self._order("enabled_queue_order", self.queue_order_fns, l, r)
@@ -295,6 +312,16 @@ class Session:
         if lt == rt:
             return l.uid < r.uid
         return lt < rt
+
+    def queue_order_cmp(self, l: QueueInfo, r: QueueInfo) -> int:
+        j = self._order("enabled_queue_order", self.queue_order_fns, l, r)
+        if j != 0:
+            return j
+        lt = l.queue.metadata.creation_timestamp
+        rt = r.queue.metadata.creation_timestamp
+        if lt == rt:
+            return -1 if l.uid < r.uid else (1 if l.uid > r.uid else 0)
+        return -1 if lt < rt else 1
 
     def task_compare_fns(self, l: TaskInfo, r: TaskInfo) -> int:
         return self._order("enabled_task_order", self.task_order_fns, l, r)

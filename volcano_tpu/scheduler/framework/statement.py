@@ -145,6 +145,11 @@ class Statement:
     def _unallocate(self, task: TaskInfo, reason: str) -> None:
         ssn = self.ssn
         ssn._placement_gen += 1
+        # release any volume assumption made by allocate's
+        # cache.allocate_volumes (bound volumes are untouched)
+        unassume = getattr(ssn.cache.volume_binder, "unassume", None)
+        if unassume is not None:
+            unassume(task)
         job = ssn.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.PENDING)

@@ -98,7 +98,13 @@ _registry_lock = threading.Lock()
 
 
 def registry() -> Registry:
+    # double-checked fast path: per-victim hot loops (preempt/reclaim)
+    # call through here thousands of times per session, and the global
+    # assignment below is atomic under the GIL
     global _registry
+    r = _registry
+    if r is not None:
+        return r
     with _registry_lock:
         if _registry is None:
             _registry = Registry()

@@ -26,17 +26,46 @@ class _Item:
         return self.seq < other.seq  # stable among equals
 
 
+class _CmpItem:
+    """Heap item over a 3-way comparator: one dispatch per comparison
+    instead of the boolean protocol's two (the equality probe) — the
+    job/queue order chains cost microseconds per call, and heap pops at
+    preempt scale pay ~log(n) comparisons each."""
+
+    __slots__ = ("value", "cmp_fn", "seq")
+
+    def __init__(self, value, cmp_fn, seq):
+        self.value = value
+        self.cmp_fn = cmp_fn
+        self.seq = seq
+
+    def __lt__(self, other: "_CmpItem") -> bool:
+        j = self.cmp_fn(self.value, other.value)
+        if j != 0:
+            return j < 0
+        return self.seq < other.seq  # stable among equals
+
+
 class PriorityQueue:
     """Pop returns the item for which less_fn says it orders before all
-    others ("highest priority first" by convention of the less fns)."""
+    others ("highest priority first" by convention of the less fns).
+    ``cmp_fn`` (3-way, -1/0/1) is the cheaper protocol when the caller
+    has one — identical ordering to the equivalent less_fn."""
 
-    def __init__(self, less_fn: Optional[Callable] = None):
-        self._heap: list[_Item] = []
+    def __init__(self, less_fn: Optional[Callable] = None,
+                 cmp_fn: Optional[Callable] = None):
+        self._heap: list = []
         self._less_fn = less_fn
+        self._cmp_fn = cmp_fn
         self._seq = itertools.count()
 
     def push(self, value) -> None:
-        heapq.heappush(self._heap, _Item(value, self._less_fn, next(self._seq)))
+        if self._cmp_fn is not None:
+            heapq.heappush(
+                self._heap, _CmpItem(value, self._cmp_fn, next(self._seq)))
+        else:
+            heapq.heappush(
+                self._heap, _Item(value, self._less_fn, next(self._seq)))
 
     def pop(self):
         if not self._heap:

@@ -44,7 +44,7 @@ class AdmissionError(ValueError):
 
 
 # Kinds without a namespace (keyed by bare name).
-CLUSTER_SCOPED = {"Node", "Queue", "PriorityClass"}
+CLUSTER_SCOPED = {"Node", "Queue", "PriorityClass", "PersistentVolume"}
 
 
 def object_key(obj) -> str:

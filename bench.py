@@ -7,14 +7,21 @@ line exists so a time-boxed harness that kills the run mid-way still
 captures the headline:
     {"metric": "...", "value": N, "unit": "ms", "vs_baseline": N}
 
-- value: TPU-backend allocate-session latency (encode + device solve + apply)
-  at the headline config (BASELINE.json cfg 5: 50k tasks x 10k nodes), warm
-  (compile excluded — the scheduler reuses the compiled program every cycle).
-- vs_baseline: speedup over the serial oracle loop at the same config. The
+- value: TPU-backend END-TO-END session latency (open_session + actions +
+  close_session — the exact span the production loop's e2e metric and the
+  reference's E2eSchedulingLatency measure), warm MEDIAN across samples, at
+  the headline config (BASELINE.json cfg 5: 50k tasks x 10k nodes). Compile
+  excluded (the scheduler reuses the compiled program every cycle); nothing
+  else is excluded — session open and the close-time mirror flush are inside
+  the timed window. The full record (all configs, per-phase and per-action
+  splits, every sample) is also written to BENCH_local.json.
+- vs_baseline: speedup over the serial oracle loop at the same config, on
+  MATCHING spans — serial full-session e2e over tpu warm-median e2e. The
   reference publishes no numbers (BASELINE.md), so the baseline is the
   serial path measured here; where the serial loop would take > --serial-budget
-  seconds it is measured at a reduced scale and extrapolated linearly in
-  (tasks x nodes), reported with "serial_extrapolated": true.
+  seconds its actions window is measured at a reduced scale and extrapolated
+  linearly in (tasks x nodes) (open/close extrapolate linearly in scale),
+  reported with "serial_extrapolated": true.
 
 Usage:
     python bench.py                     # headline (cfg 5, full scale)
@@ -31,7 +38,15 @@ import time
 
 
 def _session_once(cache, tiers, actions, mesh=None):
-    """Open a session, run the actions, close; returns (latency_s, binds, profile)."""
+    """Open a session, run the actions, close; returns per-phase timings.
+
+    The measured span is the full production cycle — open_session through
+    close_session — exactly what Scheduler.run_once times into its e2e
+    metric (volcano_tpu/scheduler/scheduler.py:211-223) and what the
+    reference's E2eSchedulingLatency covers (reference
+    pkg/scheduler/metrics/metrics.go:38-45, spanning scheduler.go:71-87).
+    Work deferred to close (the cache-mirror flush) is inside the window.
+    """
     import volcano_tpu.scheduler.actions  # noqa: F401 (register actions)
     from volcano_tpu.scheduler.framework import close_session, get_action, open_session
 
@@ -54,11 +69,15 @@ def _session_once(cache, tiers, actions, mesh=None):
     t0 = time.perf_counter()
     ssn = open_session(cache, tiers)
     t_open = time.perf_counter()
+    action_ms = {}
     for name in actions:
+        ta = time.perf_counter()
         get_action(name).execute(ssn)
+        action_ms[name] = round((time.perf_counter() - ta) * 1e3, 3)
     t_act = time.perf_counter()
     profile = dict(ssn.plugins["tpuscore"].profile) if "tpuscore" in ssn.plugins else {}
     close_session(ssn)
+    t_close = time.perf_counter()
     # compile accounting: a warm session with compiles > 0 is a retrace —
     # exactly the regression the warm-sample spread is meant to expose
     if win is not None:
@@ -68,6 +87,9 @@ def _session_once(cache, tiers, actions, mesh=None):
     return {
         "open_s": t_open - t0,
         "actions_s": t_act - t_open,
+        "close_s": t_close - t_act,
+        "e2e_s": t_close - t0,
+        "action_ms": action_ms,
         "binds": len(cache.binder.binds),
         "profile": profile,
     }
@@ -78,8 +100,18 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
     warm_iters = max(warm_iters, 1)
     from volcano_tpu.bench.clusters import CONFIGS, build_config
 
+    # build the native engines BEFORE any timed window — including the
+    # serial baseline, whose session transition path also reaches for
+    # fasttrans: the _nowait accessors silently fall back to Python while
+    # the background cc runs, which would bench the wrong implementation
+    from volcano_tpu import _native
+
+    native_ok = {"fastapply": _native.get_fastapply() is not None,
+                 "fasttrans": _native.get_fasttrans() is not None}
+
     bc = CONFIGS[cfg]
-    out = {"config": cfg, "name": bc.name, "scale": scale}
+    out = {"config": cfg, "name": bc.name, "scale": scale,
+           "native_engines": native_ok}
 
     if backend in ("serial", "both", "auto"):
         # estimate serial cost before committing to it: measured at small
@@ -99,15 +131,23 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
         cache, serial_tiers, _, actions, n_tasks = build_config(cfg, serial_scale)
         r = _session_once(cache, serial_tiers, actions)
         serial_s = r["actions_s"]
+        open_close_s = r["open_s"] + r["close_s"]
         if serial_scale < scale:
             factor = (scale * scale) / (serial_scale * serial_scale)
             out["serial_measured_scale"] = serial_scale
             out["serial_measured_ms"] = serial_s * 1e3
             serial_s = serial_s * factor
+            # open/close walk every object once -> ~linear in scale, not
+            # quadratic like the per-(task,node) action loops
+            open_close_s = open_close_s * (scale / serial_scale)
             out["serial_extrapolated"] = True
         out["serial_ms"] = serial_s * 1e3
+        # full-session serial span, matching tpu_e2e_*: actions plus the
+        # (linearly extrapolated, when reduced-scale) open+close
+        out["serial_e2e_ms"] = round((serial_s + open_close_s) * 1e3, 3)
         out["serial_binds"] = r["binds"]
         out["serial_open_ms"] = round(r["open_s"] * 1e3, 3)
+        out["serial_close_ms"] = round(r["close_s"] * 1e3, 3)
         if verbose:
             print(f"[cfg{cfg}] serial: {out['serial_ms']:.1f} ms "
                   f"({'extrapolated' if out.get('serial_extrapolated') else 'measured'})",
@@ -125,7 +165,8 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
         # connection whose per-round-trip latency jitters by 2-3x, and the
         # min is the reproducible figure (the scheduler reuses the compiled
         # program every cycle).
-        samples = []
+        samples = []        # actions window, ms (back-compat headline)
+        e2e_samples = []    # open + actions + close, ms — the honest span
         warm = None
         warm_compiles = []
         for _ in range(warm_iters):
@@ -140,21 +181,29 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
             gc.collect()
             w = _session_once(cache, tpu_tiers, actions, mesh=mesh)
             samples.append(w["actions_s"] * 1e3)
+            e2e_samples.append(w["e2e_s"] * 1e3)
             warm_compiles.append(w["profile"].get("compiles", 0))
-            if warm is None or w["actions_s"] * 1e3 <= min(samples):
+            if warm is None or w["e2e_s"] * 1e3 <= min(e2e_samples):
                 warm = w
         # min is the reproducible figure on a jittery tunneled link, but a
         # min-only report buries warm-path retraces/stalls — median and max
-        # make the spread (and any hidden recompile) part of the record
+        # make the spread (and any hidden recompile) part of the record.
+        # The BARS bind on median e2e: the full production span, at the
+        # middle of the observed jitter, not its luckiest tail.
         import statistics
 
         out["tpu_ms"] = min(samples)
         out["tpu_warm_median_ms"] = round(statistics.median(samples), 3)
         out["tpu_warm_max_ms"] = round(max(samples), 3)
-        # session-open (snapshot/clone) cost, outside the measured actions
-        # window on BOTH backends — recorded so nothing is hidden there
-        out["tpu_open_ms"] = round(warm["open_s"] * 1e3, 3)
         out["tpu_warm_samples_ms"] = [round(s, 3) for s in samples]
+        out["tpu_e2e_ms"] = round(min(e2e_samples), 3)
+        out["tpu_e2e_median_ms"] = round(statistics.median(e2e_samples), 3)
+        out["tpu_e2e_samples_ms"] = [round(s, 3) for s in e2e_samples]
+        # phase split of the best-e2e sample: nothing hides outside the
+        # timed window anymore, but the split still shows where it went
+        out["tpu_open_ms"] = round(warm["open_s"] * 1e3, 3)
+        out["tpu_close_ms"] = round(warm["close_s"] * 1e3, 3)
+        out["tpu_action_ms"] = warm["action_ms"]
         out["tpu_warm_compiles"] = warm_compiles
         out["tpu_binds"] = warm["binds"]
         out["tpu_profile"] = {
@@ -163,14 +212,23 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
         out["tasks"] = n_tasks
         if verbose:
             p = warm["profile"]
-            print(f"[cfg{cfg}] tpu warm: {out['tpu_ms']:.1f} ms "
+            print(f"[cfg{cfg}] tpu warm e2e: {out['tpu_e2e_ms']:.1f} ms "
+                  f"(open {out['tpu_open_ms']:.1f} actions {warm['actions_s']*1e3:.1f} "
+                  f"close {out['tpu_close_ms']:.1f}) "
                   f"(encode {p.get('encode_s', 0)*1e3:.1f} solve {p.get('solve_s', 0)*1e3:.1f} "
                   f"apply {p.get('apply_s', 0)*1e3:.1f}) binds={warm['binds']} "
-                  f"samples={[round(s) for s in samples]} compiles={warm_compiles}",
+                  f"actions={out['tpu_action_ms']} "
+                  f"e2e_samples={[round(s) for s in e2e_samples]} compiles={warm_compiles}",
                   file=sys.stderr)
 
     if "serial_ms" in out and "tpu_ms" in out and out["tpu_ms"] > 0:
-        out["speedup"] = out["serial_ms"] / out["tpu_ms"]
+        # actions-window min-vs-actions speedup, kept for cross-round
+        # comparability with r1-r4 records
+        out["speedup_actions_min"] = out["serial_ms"] / out["tpu_ms"]
+        # the published speedup binds on MATCHING spans at matching
+        # percentiles: serial full-session e2e over tpu warm MEDIAN e2e
+        if out.get("tpu_e2e_median_ms", 0) > 0:
+            out["speedup"] = out["serial_e2e_ms"] / out["tpu_e2e_median_ms"]
     return out
 
 
@@ -240,12 +298,17 @@ def main() -> int:
             pass
 
     def headline_json(headline):
+        # the headline value is the MEDIAN e2e session latency — the full
+        # open+actions+close span the production loop and the reference both
+        # measure, at the middle of the link jitter (not the luckiest min)
+        value = headline.get("tpu_e2e_median_ms",
+                             headline.get("tpu_ms", headline.get("serial_ms", 0.0)))
         final = {
-            "metric": "scheduler-session latency (ms) @ %dk tasks x %dk nodes"
+            "metric": "scheduler e2e session latency, warm median (ms) @ %dk tasks x %dk nodes"
                       % (int(50 * args.scale), int(10 * args.scale))
                       if headline["config"] == 5 else
-                      f"scheduler-session latency (ms), cfg {headline['config']} ({headline['name']})",
-            "value": round(headline.get("tpu_ms", headline.get("serial_ms", 0.0)), 3),
+                      f"scheduler e2e session latency, warm median (ms), cfg {headline['config']} ({headline['name']})",
+            "value": round(value, 3),
             "unit": "ms",
             "vs_baseline": round(headline.get("speedup", 0.0), 3),
         }
@@ -257,6 +320,43 @@ def main() -> int:
             final["serial_measured_scale"] = headline.get("serial_measured_scale")
         return final
 
+    import os
+
+    def write_record(results, final=None):
+        # persist the COMPLETE record from here, re-written after EVERY
+        # config: the driver keeps only the last 2,000 chars of stdout
+        # (which lost cfg1/2/3/5 in rounds 3 AND 4), and a time-boxed
+        # harness can kill the run mid-sweep — the file survives both
+        try:
+            import subprocess
+
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__))
+            ).stdout.strip() or None
+        except Exception:
+            sha = None
+        record = {"rtt_floor_ms": rtt_floor_ms, "git_sha": sha,
+                  "argv": sys.argv[1:],
+                  "complete": final is not None,
+                  "results": [
+                      {k: v for k, v in r.items() if k != "tpu_cold_profile"}
+                      for r in results]}
+        if final is not None:
+            record["headline"] = {k: v for k, v in final.items()
+                                  if k != "all_configs"}
+        try:
+            out_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_local.json")
+            with open(out_path, "w") as fh:
+                json.dump(record, fh, indent=1)
+                fh.write("\n")
+        except Exception as e:
+            print(f"[bench] could not write BENCH_local.json: {e}",
+                  file=sys.stderr)
+
     results = []
     # headline (cfg 5) runs FIRST and prints its JSON line immediately: a
     # time-boxed harness that kills the run mid-way still captures the
@@ -267,6 +367,7 @@ def main() -> int:
         results.append(run_config(cfg, args.scale, args.backend,
                                   args.serial_budget, mesh=mesh,
                                   warm_iters=args.warm_iters))
+        write_record(results)
         if cfg == 5 and len(cfgs) > 1:
             print(json.dumps(headline_json(results[0])), flush=True)
 
@@ -283,6 +384,7 @@ def main() -> int:
             {k: v for k, v in r.items() if k != "tpu_cold_profile"}
             for r in results
         ]
+    write_record(results, final=final)
     print(json.dumps(final))
     return 0
 

@@ -1,0 +1,173 @@
+"""Fast field-copying clones vs the replay oracle.
+
+NodeInfo.clone / JobInfo.clone copy the incrementally-maintained
+accounting instead of re-deriving it through add_task / add_task_info;
+clone_replay keeps the original re-derivation path. These tests churn
+state through the public mutators (including the fused update paths the
+bulk writeback and fasttrans mirror) and assert the two clones are
+value-identical — any drift between the incremental sums and the task
+set would split them apart.
+"""
+
+import random
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.job_info import JobInfo, new_task_info
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_pod,
+    build_resource_list,
+)
+
+
+def _task(name, cpu="1000m", mem="1Gi", phase=objects.POD_PHASE_PENDING,
+          node="", group="pg1", scalars=None):
+    rl = build_resource_list(cpu, mem)
+    if scalars:
+        rl.update(scalars)
+    pod = build_pod("ns1", name, node, phase, rl, group)
+    return new_task_info(pod)
+
+
+def _res_tuple(r):
+    return (r.milli_cpu, r.memory,
+            tuple(sorted((k, v) for k, v in (r.scalar_resources or {}).items()
+                         if v)))
+
+
+def _node_state(n):
+    return {
+        "name": n.name,
+        "idle": _res_tuple(n.idle),
+        "used": _res_tuple(n.used),
+        "releasing": _res_tuple(n.releasing),
+        "alloc": _res_tuple(n.allocatable),
+        "cap": _res_tuple(n.capability),
+        "phase": int(n.state.phase),
+        "reason": n.state.reason,
+        "tasks": {k: (t.uid, int(t.status), t.node_name,
+                      _res_tuple(t.resreq))
+                  for k, t in n.tasks.items()},
+        "others": id(n.others),
+    }
+
+
+def _job_state(j):
+    return {
+        "uid": j.uid,
+        "name": j.name,
+        "queue": j.queue,
+        "min_available": j.min_available,
+        "alloc": _res_tuple(j.allocated),
+        "pend": _res_tuple(j.pending_sum),
+        "total": _res_tuple(j.total_request),
+        "buckets": {int(k): sorted(v) for k, v in j.task_status_index.items()},
+        "tasks": {uid: (int(t.status), t.node_name, _res_tuple(t.resreq))
+                  for uid, t in j.tasks.items()},
+        "ready": j.ready_task_num(),
+        "valid": j.valid_task_num(),
+    }
+
+
+class TestNodeCloneOracle:
+    def test_churned_node(self):
+        rng = random.Random(7)
+        ni = NodeInfo(build_node(
+            "n1", build_resource_list("128", "256Gi",
+                                      **{"nvidia.com/gpu": "16"})))
+        tasks = []
+        for i in range(40):
+            t = _task(f"t{i}", cpu=f"{rng.choice([500, 1000, 2000])}m",
+                      phase=objects.POD_PHASE_RUNNING, node="n1",
+                      scalars={"nvidia.com/gpu": "1"} if i % 4 == 0 else None)
+            ni.add_task(t)
+            tasks.append(t)
+        # churn: remove some, flip statuses through update_task (the fused
+        # transition path), remove again
+        for t in tasks[::3]:
+            ni.remove_task(t)
+        for t in tasks[1::3]:
+            flip = t.shared_clone()
+            flip.status = TaskStatus.RELEASING
+            ni.update_task(flip)
+        fast = ni.clone()
+        replay = ni.clone_replay()
+        assert _node_state(fast) == _node_state(replay)
+        # the clone is independent: mutating it leaves the source intact
+        before = _node_state(ni)
+        fast.idle.milli_cpu -= 500
+        fast.tasks.clear()
+        assert _node_state(ni) == before
+
+    def test_empty_and_nodeless(self):
+        ni = NodeInfo(build_node("n2", build_resource_list("4", "8Gi")))
+        assert _node_state(ni.clone()) == _node_state(ni.clone_replay())
+        bare = NodeInfo(None)
+        assert _node_state(bare.clone()) == _node_state(bare.clone_replay())
+
+
+class TestJobCloneOracle:
+    def _churned_job(self):
+        job = JobInfo("ns1/pg1")
+        pg = objects.PodGroup(
+            metadata=objects.ObjectMeta(name="pg1", namespace="ns1"),
+            spec=objects.PodGroupSpec(min_member=3, queue="default"),
+        )
+        job.set_pod_group(pg)
+        tasks = []
+        for i in range(30):
+            t = _task(f"t{i}",
+                      phase=(objects.POD_PHASE_RUNNING if i % 3 == 0
+                             else objects.POD_PHASE_PENDING),
+                      node=("n1" if i % 3 == 0 else ""))
+            job.add_task_info(t)
+            tasks.append(t)
+        # fused status churn across the PENDING and allocated boundaries
+        for t in tasks[1::5]:
+            flip = t.shared_clone()
+            job.update_task_status(flip, TaskStatus.ALLOCATED)
+        for t in tasks[2::5]:
+            flip = t.shared_clone()
+            job.update_task_status(flip, TaskStatus.PIPELINED)
+        for t in tasks[::6]:
+            if t.uid in job.tasks:
+                job.delete_task_info(job.tasks[t.uid])
+        return job
+
+    def test_churned_job(self):
+        job = self._churned_job()
+        fast = job.clone()
+        replay = job.clone_replay()
+        assert _job_state(fast) == _job_state(replay)
+        # pending axis: same tasks in the same order, version-valid
+        fa, ra = fast.pending_axis(), replay.pending_axis()
+        assert fa is not None and ra is not None
+        assert [t.uid for t in fa[0]] == [t.uid for t in ra[0]]
+        assert fa[1] == ra[1] and fa[2] == ra[2]
+
+    def test_incremental_sums_match_recompute(self):
+        from volcano_tpu.api.types import allocated_status
+
+        job = self._churned_job()
+        alloc = sum(t.resreq.milli_cpu for t in job.tasks.values()
+                    if allocated_status(t.status))
+        pend = sum(t.resreq.milli_cpu for t in job.tasks.values()
+                   if t.status == TaskStatus.PENDING)
+        assert job.allocated.milli_cpu == alloc
+        assert job.pending_sum.milli_cpu == pend
+
+    def test_clone_is_independent(self):
+        job = self._churned_job()
+        fast = job.clone()
+        before = _job_state(job)
+        # mutate the clone through the public mutators
+        any_pending = next(iter(
+            job.task_status_index.get(TaskStatus.PENDING, {}).values()), None)
+        if any_pending is not None:
+            flip = fast.tasks[any_pending.uid].shared_clone()
+            fast.update_task_status(flip, TaskStatus.ALLOCATED)
+        fast.allocated.milli_cpu += 123
+        fast.pending_sum.milli_cpu += 7
+        assert _job_state(job) == before

@@ -51,6 +51,7 @@ def _run(cfg: int, scale: float, no_native: bool):
         jobs = {
             uid: {
                 "alloc": _res_tuple(j.allocated),
+                "pend": _res_tuple(j.pending_sum),
                 "buckets": {int(k): sorted(v)
                             for k, v in j.task_status_index.items()},
                 "ver": j._status_version,

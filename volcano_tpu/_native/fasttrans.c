@@ -44,7 +44,8 @@ static PyObject *s_milli_cpu, *s_memory, *s_scalar_resources, *s_status,
     *s_metadata, *s_namespace, *s_name, *s_acct_gen, *s_idle, *s_used,
     *s_releasing, *s_node, *s_state, *s_update_task_status, *s_update_task,
     *s_shared_clone, *s_priority, *s_volume_ready, *s_row, *s_row_gen,
-    *s_key, *s_share, *s_dominant_resource, *s_deserved, *s_error;
+    *s_key, *s_share, *s_dominant_resource, *s_deserved, *s_error,
+    *s_pending_sum;
 
 static int
 intern_all(void)
@@ -66,6 +67,7 @@ intern_all(void)
     I(s_row, "row") I(s_row_gen, "row_gen") I(s_key, "key")
     I(s_share, "share") I(s_dominant_resource, "dominant_resource")
     I(s_deserved, "deserved") I(s_error, "error")
+    I(s_pending_sum, "pending_sum")
 #undef I
     return 0;
 }
@@ -593,6 +595,44 @@ job_update_task_status(TransCtx *ctx, PyObject *job, PyObject *task,
             Py_DECREF(old_status);
             Py_DECREF(index);
             goto fail;
+        }
+    }
+
+    /* pending boundary accounting — the PENDING-bucket request sum kept
+     * incrementally on JobInfo (job_info.py update_task_status's fused
+     * path), mirrored here so native transitions keep it in sync */
+    {
+        int old_p = (old_status == ctx->st_pending) ? 1 :
+            PyObject_RichCompareBool(old_status, ctx->st_pending, Py_EQ);
+        int new_p = (old_p < 0) ? -1 :
+            ((new_status == ctx->st_pending) ? 1 :
+             PyObject_RichCompareBool(new_status, ctx->st_pending, Py_EQ));
+        if (new_p < 0) {
+            Py_DECREF(old_status);
+            Py_DECREF(index);
+            goto fail;
+        }
+        if (old_p != new_p) {
+            PyObject *psum = PyObject_GetAttr(job, s_pending_sum);
+            PyObject *req = psum ? PyObject_GetAttr(stored, s_resreq) : NULL;
+            int rc;
+            if (req == NULL) {
+                Py_XDECREF(psum);
+                Py_DECREF(old_status);
+                Py_DECREF(index);
+                goto fail;
+            }
+            if (old_p)
+                rc = res_sub(psum, req, ctx->assert_cb);
+            else
+                rc = res_add(psum, req);
+            Py_DECREF(psum);
+            Py_DECREF(req);
+            if (rc < 0) {
+                Py_DECREF(old_status);
+                Py_DECREF(index);
+                goto fail;
+            }
         }
     }
     Py_DECREF(old_status);

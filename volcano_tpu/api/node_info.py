@@ -202,10 +202,40 @@ class NodeInfo:
     # -- misc --------------------------------------------------------------
 
     def clone(self) -> "NodeInfo":
+        """Field-copying clone: the accounting Resources are deep-copied
+        (the session and the bulk writeback mutate idle/used/releasing in
+        place), tasks are status-frozen shared_clones, and the parsed
+        allocatable/capability are copied WITHOUT re-parsing the node's
+        quantity strings — the replay clone (clone_replay) re-derived all
+        accounting through add_task, costing 12 parse_quantity calls and a
+        per-task replay per node per snapshot. End state is identical
+        (asserted by tests against clone_replay); the invariant that
+        accounting == sum over held tasks is maintained incrementally by
+        every mutator above."""
+        res = NodeInfo.__new__(NodeInfo)
+        res.node = self.node
+        res.name = self.name
+        res.releasing = self.releasing.clone()
+        res.used = self.used.clone()
+        res.idle = self.idle.clone()
+        res.allocatable = self.allocatable.clone()
+        res.capability = self.capability.clone()
+        res.tasks = {k: t.shared_clone() for k, t in self.tasks.items()}
+        res.others = self.others
+        res._acct_gen = self._acct_gen
+        res.state = NodeState(self.state.phase, self.state.reason)
+        return res
+
+    def clone_replay(self) -> "NodeInfo":
+        """Replay clone: rebuild accounting from the node object + held
+        tasks through add_task (the original clone path). Kept as the
+        oracle for clone() — any drift between the incremental accounting
+        and the task set shows up as a mismatch between the two."""
         res = NodeInfo(self.node)
         for task in self.tasks.values():
             res.add_task(task)
         res.others = self.others
+        res._acct_gen = self._acct_gen
         return res
 
     def pods(self) -> list:

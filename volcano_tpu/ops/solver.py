@@ -687,11 +687,14 @@ class BatchAllocator:
                     bind_keys.append(key)
 
                 # PENDING -> BINDING leaves total_request unchanged;
-                # allocated grows by the job's placed sum
+                # allocated grows by the job's placed sum, pending_sum
+                # shrinks by it (every placed task left the PENDING bucket)
                 vec = job_sums_l[ji]
                 apply_delta(job.allocated, vec, +1.0)
+                apply_delta(job.pending_sum, vec, -1.0)
                 if cache_job is not None:
                     apply_delta(cache_job.allocated, vec, +1.0)
+                    apply_delta(cache_job.pending_sum, vec, -1.0)
         finally:
             if gc_was:
                 gc.enable()

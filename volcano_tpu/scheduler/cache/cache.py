@@ -750,6 +750,8 @@ class SchedulerCache:
                             cnode.tasks[task.key] = task
                     _add_res_vec(cache_job.allocated, job_sums[ji],
                                  +1.0, scalar_names)
+                    _add_res_vec(cache_job.pending_sum, job_sums[ji],
+                                 -1.0, scalar_names)
                 sums = p["node_sums"].tolist()
                 for ni in p["node_nz"].tolist():
                     cnode = self.nodes.get(node_names[ni])

@@ -59,6 +59,28 @@ class NodeAxis:
         self.node_cnt = node_cnt
         self.max_tasks = max_tasks
 
+    def total_alloc(self):
+        """Cluster-total allocatable as (milli_cpu, memory, {scalar: sum})
+        — the columnar replacement for the per-node Resource.add loop the
+        drf/proportion session-open passes used to run (drf.go:78-80).
+        max_task_num deliberately excluded, as Resource.add excludes it."""
+        return (
+            float(self.cpu["alloc"].sum()),
+            float(self.mem["alloc"].sum()),
+            {rn: float(col.sum())
+             for rn, col in self.scalars["alloc"].items()},
+        )
+
+    def add_total_into(self, res) -> None:
+        """res += cluster-total allocatable (columnar). The one shared
+        implementation of the axis-vs-walk totaling fold for session-open
+        plugins (drf/proportion)."""
+        mc, mem, scal = self.total_alloc()
+        res.milli_cpu += mc
+        res.memory += mem
+        for rn, q in scal.items():
+            res.add_scalar(rn, q)
+
     def validate(self) -> bool:
         """True when every captured node's accounting generation is
         unchanged (nothing mutated node state since snapshot)."""
@@ -68,6 +90,18 @@ class NodeAxis:
             return True
         gens = np.fromiter((nd._acct_gen for nd in nodes), np.int64, n)
         return bool(np.array_equal(gens, self.gens))
+
+
+def add_total_allocatable(ssn, res) -> None:
+    """res += total allocatable over the session's ready nodes, via the
+    snapshot-captured axis when it is still generation-valid, else the
+    per-node walk. Shared by drf/proportion on_session_open."""
+    axis = getattr(ssn, "node_axis", None)
+    if axis is not None and axis.validate():
+        axis.add_total_into(res)
+    else:
+        for node in ssn.nodes.values():
+            res.add(node.allocatable)
 
 
 def _node_flag_bits(info) -> int:

@@ -14,7 +14,7 @@ from typing import Dict, List
 
 from volcano_tpu.api.resource import Resource
 from volcano_tpu.api.share_helpers import min_resource, share as share_fn
-from volcano_tpu.api.types import TaskStatus, allocated_status
+
 from volcano_tpu.scheduler.framework.event_handlers import EventHandler
 from volcano_tpu.scheduler.framework.interface import Plugin
 
@@ -52,23 +52,22 @@ class ProportionPlugin(Plugin):
         attr.share = res
 
     def on_session_open(self, ssn) -> None:
-        for node in ssn.nodes.values():
-            self.total_resource.add(node.allocatable)
+        from volcano_tpu.scheduler.cache.nodeaxis import add_total_allocatable
 
-        # queue attributes from jobs (proportion.go:72-102)
+        add_total_allocatable(ssn, self.total_resource)
+
+        # queue attributes from jobs (proportion.go:72-102): the per-task
+        # walk collapses to the incrementally-maintained job sums —
+        # allocated-status requests (job.allocated) and PENDING requests
+        # (job.pending_sum), two O(1) adds per job
         for job in ssn.jobs.values():
             if job.queue not in self.queue_opts:
                 queue = ssn.queues[job.queue]
                 self.queue_opts[job.queue] = _QueueAttr(queue.uid, queue.name, queue.weight)
             attr = self.queue_opts[job.queue]
-            for status, tasks in job.task_status_index.items():
-                if allocated_status(status):
-                    for t in tasks.values():
-                        attr.allocated.add(t.resreq)
-                        attr.request.add(t.resreq)
-                elif status == TaskStatus.PENDING:
-                    for t in tasks.values():
-                        attr.request.add(t.resreq)
+            attr.allocated.add(job.allocated)
+            attr.request.add(job.allocated)
+            attr.request.add(job.pending_sum)
 
         # iterative water-filling of deserved (proportion.go:104-157)
         remaining = self.total_resource.clone()

@@ -301,8 +301,11 @@ def main() -> int:
         # the headline value is the MEDIAN e2e session latency — the full
         # open+actions+close span the production loop and the reference both
         # measure, at the middle of the link jitter (not the luckiest min)
-        value = headline.get("tpu_e2e_median_ms",
-                             headline.get("tpu_ms", headline.get("serial_ms", 0.0)))
+        value = headline.get(
+            "tpu_e2e_median_ms",
+            headline.get("serial_e2e_ms",     # --backend serial: same span
+                         headline.get("tpu_ms",
+                                      headline.get("serial_ms", 0.0))))
         final = {
             "metric": "scheduler e2e session latency, warm median (ms) @ %dk tasks x %dk nodes"
                       % (int(50 * args.scale), int(10 * args.scale))

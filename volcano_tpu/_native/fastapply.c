@@ -18,7 +18,8 @@
 
 static PyObject *s_node_name, *s_status, *s_uid, *s_namespace, *s_name,
     *s_tasks, *s_pod, *s_status_version, *s_task_status_index, *s_allocated,
-    *s_key, *s_acct_gen;
+    *s_key, *s_acct_gen, *s_pending_sum, *s_resreq, *s_milli_cpu_g,
+    *s_memory_g, *s_scalar_res_g;
 
 /* apply_job_tasks(tis, task_infos, assign, node_names, binding,
  *                 s_pending, s_binding, c_tasks, c_pending, c_binding,
@@ -619,11 +620,28 @@ apply_all_jobs(PyObject *self, PyObject *args)
             Py_DECREF(alloc);
             if (rc < 0)
                 goto job_fail;
+            /* every placed task left the PENDING bucket: the
+             * incrementally-maintained pending request sum shrinks by
+             * the same vector (job_info.py pending_sum) */
+            alloc = PyObject_GetAttr(job, s_pending_sum);
+            if (alloc == NULL)
+                goto job_fail;
+            rc = res_add_vec(alloc, vec, R, scalar_names, -1.0);
+            Py_DECREF(alloc);
+            if (rc < 0)
+                goto job_fail;
             if (cache_job != NULL) {
                 alloc = PyObject_GetAttr(cache_job, s_allocated);
                 if (alloc == NULL)
                     goto job_fail;
                 rc = res_add_vec(alloc, vec, R, scalar_names, 1.0);
+                Py_DECREF(alloc);
+                if (rc < 0)
+                    goto job_fail;
+                alloc = PyObject_GetAttr(cache_job, s_pending_sum);
+                if (alloc == NULL)
+                    goto job_fail;
+                rc = res_add_vec(alloc, vec, R, scalar_names, -1.0);
                 Py_DECREF(alloc);
                 if (rc < 0)
                     goto job_fail;
@@ -885,9 +903,357 @@ done:
     return ret;
 }
 
+/* mirror_all_jobs(job_nz, seg_ends, placed, assign, task_infos,
+ *                 node_names, cache_nodes, job_infos, cache_jobs,
+ *                 pending, binding, job_sums, scalar_names)
+ *
+ * The CACHE half of apply_all_jobs, for the deferred mirror flush
+ * (scheduler/cache/cache.py flush_mirror): per cache-job status flips,
+ * bucket moves, session-task inserts into cache node maps, and
+ * allocated/pending_sum deltas. Unlike the session side, the cache may
+ * have CHURNED in the defer window (watch events delete/re-status
+ * tasks), so there is NO wholesale bucket-move fast path and every move
+ * pops from the task's ACTUAL current bucket with update_task_status's
+ * boundary rules (alloc_mask gates the allocated add; only tasks leaving
+ * PENDING shrink pending_sum) — identical to the Python fallback loop,
+ * which stays as the oracle. Caller holds the cache lock. */
+static PyObject *
+mirror_all_jobs(PyObject *self, PyObject *args)
+{
+    PyObject *job_nz_o, *seg_ends_o, *placed_o, *assign_o;
+    PyObject *task_infos, *node_names, *cache_nodes;
+    PyObject *job_infos, *cache_jobs, *pending, *binding;
+    PyObject *job_sums_o, *scalar_names;
+    long alloc_mask;
+
+    if (!PyArg_ParseTuple(args, "OOOOOOOOOOOOOl",
+                          &job_nz_o, &seg_ends_o, &placed_o, &assign_o,
+                          &task_infos, &node_names, &cache_nodes,
+                          &job_infos, &cache_jobs, &pending, &binding,
+                          &job_sums_o, &scalar_names, &alloc_mask))
+        return NULL;
+
+    Py_buffer job_nz_b = {0}, seg_ends_b = {0}, placed_b = {0},
+              assign_b = {0}, sums_b = {0};
+    PyObject **ctasks_n = NULL;
+    char *cresolved = NULL;
+    PyObject *ret = NULL;
+
+    if (get_i64(job_nz_o, &job_nz_b, "job_nz") < 0)
+        return NULL;
+    if (get_i64(seg_ends_o, &seg_ends_b, "seg_ends") < 0)
+        goto done;
+    if (get_i64(placed_o, &placed_b, "placed") < 0)
+        goto done;
+    if (get_i64(assign_o, &assign_b, "assign") < 0)
+        goto done;
+    if (PyObject_GetBuffer(job_sums_o, &sums_b, PyBUF_CONTIG_RO) < 0)
+        goto done;
+    if (sums_b.itemsize != 8) {
+        PyErr_SetString(PyExc_TypeError, "job_sums: expected float64 buffer");
+        goto done;
+    }
+
+    const int64_t *job_nz = (const int64_t *)job_nz_b.buf;
+    const int64_t *seg_ends = (const int64_t *)seg_ends_b.buf;
+    const int64_t *placed = (const int64_t *)placed_b.buf;
+    const int64_t *assign = (const int64_t *)assign_b.buf;
+    const double *sums = (const double *)sums_b.buf;
+    Py_ssize_t n_jobs_nz = job_nz_b.len / 8;
+    Py_ssize_t R = sums_b.len ? (sums_b.ndim == 2 ? sums_b.shape[1]
+                                                  : sums_b.len / 8) : 0;
+    Py_ssize_t n_nodes = PyList_GET_SIZE(node_names);
+
+    ctasks_n = PyMem_Calloc(n_nodes ? n_nodes : 1, sizeof(PyObject *));
+    cresolved = PyMem_Calloc(n_nodes ? n_nodes : 1, 1);
+    if (!ctasks_n || !cresolved) {
+        PyErr_NoMemory();
+        goto done;
+    }
+
+    int64_t lo = 0;
+    for (Py_ssize_t jj = 0; jj < n_jobs_nz; jj++) {
+        int64_t ji = job_nz[jj];
+        int64_t hi = seg_ends[jj];
+        Py_ssize_t seg_len = (Py_ssize_t)(hi - lo);
+        PyObject *job = PyList_GET_ITEM(job_infos, ji);      /* borrowed */
+
+        PyObject *juid = PyObject_GetAttr(job, s_uid);       /* new */
+        if (juid == NULL)
+            goto done;
+        PyObject *cache_job = PyDict_GetItemWithError(cache_jobs, juid);
+        Py_DECREF(juid);
+        if (cache_job == NULL) {
+            if (PyErr_Occurred())
+                goto done;
+            lo = hi;  /* job no longer in the cache: skip its segment */
+            continue;
+        }
+
+        if (bump_version(cache_job) < 0)
+            goto done;
+        PyObject *c_tasks = PyObject_GetAttr(cache_job, s_tasks); /* new */
+        if (c_tasks == NULL)
+            goto done;
+        PyObject *cidx = PyObject_GetAttr(cache_job, s_task_status_index);
+        if (cidx == NULL)
+            goto job_fail2;
+
+        /* per-flipped-task accounting accumulators (R <= 64 scalars is
+         * far beyond any real session; larger R falls back by erroring
+         * out to the Python oracle) */
+        double vec_alloc[64], vec_pend[64];
+        if (R > 64) {
+            PyErr_SetString(PyExc_ValueError, "mirror_all_jobs: R > 64");
+            goto job_fail;
+        }
+        for (Py_ssize_t r = 0; r < R; r++)
+            vec_alloc[r] = vec_pend[r] = 0.0;
+
+        for (int64_t k = lo; k < hi; k++) {
+            int64_t ti = placed[k];
+            int64_t ni = assign[ti];
+            PyObject *task = PyList_GET_ITEM(task_infos, ti); /* borrowed */
+            PyObject *host = PyList_GET_ITEM(node_names, ni); /* borrowed */
+
+            PyObject *uid = PyObject_GetAttr(task, s_uid);   /* new */
+            if (uid == NULL)
+                goto job_fail;
+            PyObject *ctask = PyDict_GetItemWithError(c_tasks, uid);
+            if (ctask == NULL) {
+                Py_DECREF(uid);
+                if (PyErr_Occurred())
+                    goto job_fail;
+                continue;  /* deleted in the defer window: its sums were
+                            * settled by delete_task_info already */
+            }
+
+            /* pop from the task's ACTUAL current bucket (it may have
+             * been re-statused by a watch event since the session ran),
+             * deleting the bucket when it empties — the Python oracle's
+             * exact moves */
+            PyObject *old_status = PyObject_GetAttr(ctask, s_status);
+            if (old_status == NULL) {
+                Py_DECREF(uid);
+                goto job_fail;
+            }
+            long old_l = PyLong_AsLong(old_status);
+            if (old_l == -1 && PyErr_Occurred()) {
+                Py_DECREF(old_status);
+                Py_DECREF(uid);
+                goto job_fail;
+            }
+            PyObject *old_bucket = PyDict_GetItemWithError(cidx, old_status);
+            if (old_bucket == NULL && PyErr_Occurred()) {
+                Py_DECREF(old_status);
+                Py_DECREF(uid);
+                goto job_fail;
+            }
+            if (old_bucket != NULL) {
+                if (dict_pop_ignore_missing(old_bucket, uid) < 0) {
+                    Py_DECREF(old_status);
+                    Py_DECREF(uid);
+                    goto job_fail;
+                }
+                if (PyDict_GET_SIZE(old_bucket) == 0 &&
+                    PyDict_DelItem(cidx, old_status) < 0) {
+                    Py_DECREF(old_status);
+                    Py_DECREF(uid);
+                    goto job_fail;
+                }
+            }
+
+            if (PyObject_SetAttr(ctask, s_node_name, host) < 0 ||
+                PyObject_SetAttr(ctask, s_status, binding) < 0) {
+                Py_DECREF(old_status);
+                Py_DECREF(uid);
+                goto job_fail;
+            }
+
+            /* insert into the BINDING bucket, created lazily (looked up
+             * per task: the pop above may have deleted-and-recreated it) */
+            {
+                PyObject *nb = PyDict_GetItemWithError(cidx, binding);
+                if (nb == NULL) {
+                    if (PyErr_Occurred()) {
+                        Py_DECREF(old_status);
+                        Py_DECREF(uid);
+                        goto job_fail;
+                    }
+                    nb = PyDict_New();
+                    if (nb == NULL ||
+                        PyDict_SetItem(cidx, binding, nb) < 0) {
+                        Py_XDECREF(nb);
+                        Py_DECREF(old_status);
+                        Py_DECREF(uid);
+                        goto job_fail;
+                    }
+                    Py_DECREF(nb);
+                    nb = PyDict_GetItemWithError(cidx, binding);
+                    if (nb == NULL) {
+                        Py_DECREF(old_status);
+                        Py_DECREF(uid);
+                        goto job_fail;
+                    }
+                }
+                if (PyDict_SetItem(nb, uid, ctask) < 0) {
+                    Py_DECREF(old_status);
+                    Py_DECREF(uid);
+                    goto job_fail;
+                }
+            }
+            Py_DECREF(uid);
+
+            /* boundary-ruled accounting accumulation: BINDING is in the
+             * allocated class, so allocated grows only for tasks NOT
+             * already allocated-class, and pending_sum shrinks only for
+             * tasks leaving PENDING (job_info.update_task_status rules) */
+            int was_alloc = (old_l & alloc_mask) != 0;
+            int was_pend = old_status == pending;
+            if (!was_pend) {
+                int eq = PyObject_RichCompareBool(old_status, pending, Py_EQ);
+                if (eq < 0) {
+                    Py_DECREF(old_status);
+                    goto job_fail;
+                }
+                was_pend = eq;
+            }
+            Py_DECREF(old_status);
+            if (!was_alloc || was_pend) {
+                PyObject *req = PyObject_GetAttr(ctask, s_resreq);
+                if (req == NULL)
+                    goto job_fail;
+                PyObject *mc = PyObject_GetAttr(req, s_milli_cpu_g);
+                PyObject *mem = mc ? PyObject_GetAttr(req, s_memory_g) : NULL;
+                if (mem == NULL) {
+                    Py_XDECREF(mc);
+                    Py_DECREF(req);
+                    goto job_fail;
+                }
+                double mcv = PyFloat_AsDouble(mc);
+                double memv = PyFloat_AsDouble(mem);
+                Py_DECREF(mc);
+                Py_DECREF(mem);
+                if (PyErr_Occurred()) {
+                    Py_DECREF(req);
+                    goto job_fail;
+                }
+                if (!was_alloc) { vec_alloc[0] += mcv; vec_alloc[1] += memv; }
+                if (was_pend)   { vec_pend[0] += mcv;  vec_pend[1] += memv; }
+                PyObject *scal = PyObject_GetAttr(req, s_scalar_res_g);
+                Py_DECREF(req);
+                if (scal == NULL)
+                    goto job_fail;
+                if (scal != Py_None && PyDict_GET_SIZE(scal) > 0) {
+                    PyObject *sk, *sv;
+                    Py_ssize_t pos = 0;
+                    while (PyDict_Next(scal, &pos, &sk, &sv)) {
+                        double q = PyFloat_AsDouble(sv);
+                        if (q == -1.0 && PyErr_Occurred()) {
+                            Py_DECREF(scal);
+                            goto job_fail;
+                        }
+                        for (Py_ssize_t r = 2; r < R; r++) {
+                            PyObject *rn = PyTuple_GET_ITEM(scalar_names,
+                                                            r - 2);
+                            int same = PyObject_RichCompareBool(sk, rn,
+                                                                Py_EQ);
+                            if (same < 0) {
+                                Py_DECREF(scal);
+                                goto job_fail;
+                            }
+                            if (same) {
+                                if (!was_alloc) vec_alloc[r] += q;
+                                if (was_pend)   vec_pend[r] += q;
+                                break;
+                            }
+                        }
+                    }
+                }
+                Py_DECREF(scal);
+            }
+
+            /* cache node task-map: the SESSION task object is shared in,
+             * exactly as the inline writeback and the Python flush do */
+            if (!cresolved[ni]) {
+                cresolved[ni] = 1;
+                PyObject *cnode = PyDict_GetItemWithError(cache_nodes, host);
+                if (cnode == NULL && PyErr_Occurred())
+                    goto job_fail;
+                if (cnode != NULL) {
+                    if (bump_int_attr(cnode, s_acct_gen) < 0)
+                        goto job_fail;
+                    ctasks_n[ni] = PyObject_GetAttr(cnode, s_tasks);
+                    if (ctasks_n[ni] == NULL)
+                        goto job_fail;
+                }
+            }
+            if (ctasks_n[ni] != NULL) {
+                PyObject *key = PyObject_GetAttr(task, s_key);
+                if (key == NULL)
+                    goto job_fail;
+                int rc = PyDict_SetItem(ctasks_n[ni], key, task);
+                Py_DECREF(key);
+                if (rc < 0)
+                    goto job_fail;
+            }
+        }
+
+        {
+            PyObject *res = PyObject_GetAttr(cache_job, s_allocated);
+            if (res == NULL)
+                goto job_fail;
+            int rc = res_add_vec(res, vec_alloc, R, scalar_names, 1.0);
+            Py_DECREF(res);
+            if (rc < 0)
+                goto job_fail;
+            res = PyObject_GetAttr(cache_job, s_pending_sum);
+            if (res == NULL)
+                goto job_fail;
+            rc = res_add_vec(res, vec_pend, R, scalar_names, -1.0);
+            Py_DECREF(res);
+            if (rc < 0)
+                goto job_fail;
+        }
+
+        Py_DECREF(cidx);
+        Py_DECREF(c_tasks);
+        lo = hi;
+        continue;
+    job_fail:
+        Py_DECREF(cidx);
+    job_fail2:
+        Py_DECREF(c_tasks);
+        goto done;
+    }
+
+    ret = Py_None;
+    Py_INCREF(ret);
+done:
+    if (ctasks_n) {
+        for (Py_ssize_t i = 0; i < n_nodes; i++)
+            Py_XDECREF(ctasks_n[i]);
+        PyMem_Free(ctasks_n);
+    }
+    PyMem_Free(cresolved);
+    if (job_nz_b.obj)
+        PyBuffer_Release(&job_nz_b);
+    if (seg_ends_b.obj)
+        PyBuffer_Release(&seg_ends_b);
+    if (placed_b.obj)
+        PyBuffer_Release(&placed_b);
+    if (assign_b.obj)
+        PyBuffer_Release(&assign_b);
+    if (sums_b.obj)
+        PyBuffer_Release(&sums_b);
+    return ret;
+}
+
 static PyMethodDef methods[] = {
     {"apply_job_tasks", apply_job_tasks, METH_VARARGS,
      "Native per-task placement writeback for one job segment."},
+    {"mirror_all_jobs", mirror_all_jobs, METH_VARARGS,
+     "Cache-half of apply_all_jobs for the deferred mirror flush."},
     {"apply_all_jobs", apply_all_jobs, METH_VARARGS,
      "Whole-session batched placement writeback (all jobs, one call)."},
     {"apply_node_deltas", apply_node_deltas, METH_VARARGS,
@@ -918,9 +1284,16 @@ PyInit__fastapply(void)
     s_allocated = PyUnicode_InternFromString("allocated");
     s_key = PyUnicode_InternFromString("key");
     s_acct_gen = PyUnicode_InternFromString("_acct_gen");
+    s_pending_sum = PyUnicode_InternFromString("pending_sum");
+    s_resreq = PyUnicode_InternFromString("resreq");
+    s_milli_cpu_g = PyUnicode_InternFromString("milli_cpu");
+    s_memory_g = PyUnicode_InternFromString("memory");
+    s_scalar_res_g = PyUnicode_InternFromString("scalar_resources");
+    if (!s_resreq || !s_milli_cpu_g || !s_memory_g || !s_scalar_res_g)
+        return NULL;
     if (!s_node_name || !s_status || !s_uid || !s_namespace || !s_name ||
         !s_tasks || !s_pod || !s_status_version || !s_task_status_index ||
-        !s_allocated || !s_key || !s_acct_gen)
+        !s_allocated || !s_key || !s_acct_gen || !s_pending_sum)
         return NULL;
     return PyModule_Create(&moduledef);
 }

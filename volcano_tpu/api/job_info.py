@@ -379,18 +379,19 @@ class JobInfo:
         pend_t: list = []
         pend_r: list = []
         pend_g: list = []
-        PENDING = TaskStatus.PENDING
-        for uid, task in self.tasks.items():
-            t = task.shared_clone()
-            tasks[uid] = t
-            bucket = index.get(t.status)
-            if bucket is None:
-                bucket = index[t.status] = {}
-            bucket[uid] = t
-            if t.status is PENDING:
-                pend_t.append(t)
-                pend_r.append(t.row)
-                pend_g.append(t.row_gen)
+        # bucket-wise walk: every task in a bucket shares its status, so
+        # the per-task bucket lookup and PENDING branch hoist out of the
+        # inner loop (at 50k tasks this loop is the bulk of session open)
+        for status, bucket in self.task_status_index.items():
+            nb = index[status] = {}
+            for uid, task in bucket.items():
+                t = task.shared_clone()
+                nb[uid] = t
+                tasks[uid] = t
+            if status == TaskStatus.PENDING:
+                pend_t = list(nb.values())
+                pend_r = [t.row for t in pend_t]
+                pend_g = [t.row_gen for t in pend_t]
         info._pending_axis = (pend_t, pend_r, pend_g, info._status_version)
         return info
 

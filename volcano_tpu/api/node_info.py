@@ -218,8 +218,11 @@ class NodeInfo:
         res.releasing = self.releasing.clone()
         res.used = self.used.clone()
         res.idle = self.idle.clone()
-        res.allocatable = self.allocatable.clone()
-        res.capability = self.capability.clone()
+        # allocatable/capability are REASSIGNED (set_node) but never
+        # mutated in place anywhere in the tree — shared like task
+        # resreqs, skipping two Resource deep-copies per node per snapshot
+        res.allocatable = self.allocatable
+        res.capability = self.capability
         res.tasks = {k: t.shared_clone() for k, t in self.tasks.items()}
         res.others = self.others
         res._acct_gen = self._acct_gen

@@ -573,14 +573,27 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
                         sym_terms.append((term, t.pod.metadata.namespace, ni))
 
     # ---- eligible jobs (allocate.go:49-76 filter) --------------------------
+    # when the registered validators are exactly the stock gang one, its
+    # verdict is `valid_task_num >= min_available` (gang.py valid_job_fn) —
+    # inlining it skips the per-job dispatch machinery (memo gate, flat-fn
+    # loop, ValidateResult) on the encode hot path; any other validator set
+    # keeps the full session dispatch
+    valid_plugins = _enabled_plugins(ssn, None, ssn.job_valid_fns) \
+        if hasattr(ssn, "job_valid_fns") else None
+    gang_only_valid = valid_plugins == ["gang"]
     jobs: List[JobInfo] = []
+    ssn_queues = ssn.queues
     for job in ssn.jobs.values():
         if job.pod_group is None or job.pod_group.status.phase == objects.PodGroupPhase.PENDING:
             continue
-        vr = ssn.job_valid(job)
-        if vr is not None and not vr.pass_:
-            continue
-        if job.queue not in ssn.queues:
+        if gang_only_valid:
+            if job.valid_task_num() < job.min_available:
+                continue
+        else:
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.pass_:
+                continue
+        if job.queue not in ssn_queues:
             continue
         jobs.append(job)
     j_count = len(jobs)

@@ -18,13 +18,15 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
 from volcano_tpu.api import objects
 from volcano_tpu.api.cluster_info import ClusterInfo
 from volcano_tpu.api.job_info import JobInfo, TaskInfo, new_task_info
 from volcano_tpu.api.namespace_info import NamespaceCollection
 from volcano_tpu.api.node_info import NodeInfo
 from volcano_tpu.api.queue_info import QueueInfo
-from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.api.types import TaskStatus, allocated_status
 from volcano_tpu.api.unschedule_info import ALL_NODE_UNAVAILABLE
 from volcano_tpu.scheduler.cache.interface import BindManyError
 from volcano_tpu.store import NotFoundError, Store, WatchHandler
@@ -709,12 +711,39 @@ class SchedulerCache:
             if not pending:
                 return
             BINDING = TaskStatus.BINDING
+            # native batched flush (fastapply.c mirror_all_jobs /
+            # apply_node_deltas): identical semantics to the Python body
+            # below, which remains the fallback and oracle. Non-blocking —
+            # a cold process flushes through the Python loop rather than
+            # waiting on the background cc.
+            from volcano_tpu._native import get_fastapply_nowait
+
+            mod = get_fastapply_nowait()
+            mirror_all = getattr(mod, "mirror_all_jobs", None) \
+                if mod is not None else None
+            if mirror_all is not None:
+                alloc_mask = (int(TaskStatus.BOUND) | int(TaskStatus.BINDING)
+                              | int(TaskStatus.RUNNING)
+                              | int(TaskStatus.ALLOCATED))
+                for p in pending:
+                    mirror_all(
+                        p["job_nz"], p["seg_ends"], p["placed"],
+                        p["assign"].astype(np.int64, copy=False),
+                        p["task_infos"], p["node_names"], self.nodes,
+                        p["job_infos"], self.jobs,
+                        TaskStatus.PENDING, BINDING,
+                        np.ascontiguousarray(p["job_sums"]),
+                        tuple(p["scalar_names"]), alloc_mask)
+                    mod.apply_node_deltas(
+                        p["node_nz"], np.ascontiguousarray(p["node_sums"]),
+                        p["node_names"], self.nodes, None,
+                        tuple(p["scalar_names"]))
+                return
             for p in pending:
                 task_infos = p["task_infos"]
                 node_names = p["node_names"]
                 assign = p["assign"]
                 placed = p["placed"].tolist()
-                job_sums = p["job_sums"].tolist()
                 scalar_names = p["scalar_names"]
                 lo = 0
                 for ji, hi in zip(p["job_nz"].tolist(),
@@ -732,26 +761,34 @@ class SchedulerCache:
                         task = task_infos[ti]
                         ctask = c_tasks.get(task.uid)
                         if ctask is None:
+                            # the pod was deleted in the defer window;
+                            # delete_task_info already settled its sums
                             continue
                         host = node_names[int(assign[ti])]
-                        old_bucket = cidx.get(ctask.status)
+                        old_status = ctask.status
+                        old_bucket = cidx.get(old_status)
                         if old_bucket is not None:
                             old_bucket.pop(ctask.uid, None)
                             if not old_bucket:
-                                del cidx[ctask.status]
+                                del cidx[old_status]
                         ctask.node_name = host
                         ctask.status = BINDING
                         cidx.setdefault(BINDING, {})[ctask.uid] = ctask
+                        # accounting moves are PER FLIPPED TASK with the
+                        # same boundary rules as update_task_status, not
+                        # the session's job_sums vector: a placed task
+                        # deleted or re-statused in the defer window must
+                        # not be double-counted
+                        if not allocated_status(old_status):
+                            cache_job.allocated.add(ctask.resreq)
+                        if old_status == TaskStatus.PENDING:
+                            cache_job.pending_sum.sub(ctask.resreq)
                         cnode = self.nodes.get(host)
                         if cnode is not None:
                             cnode._acct_gen += 1
                             # the session task is shared into the cache node
                             # map, exactly as the inline writeback did
                             cnode.tasks[task.key] = task
-                    _add_res_vec(cache_job.allocated, job_sums[ji],
-                                 +1.0, scalar_names)
-                    _add_res_vec(cache_job.pending_sum, job_sums[ji],
-                                 -1.0, scalar_names)
                 sums = p["node_sums"].tolist()
                 for ni in p["node_nz"].tolist():
                     cnode = self.nodes.get(node_names[ni])

@@ -79,14 +79,23 @@ class DrfPlugin(Plugin):
 
         namespace_order_enabled = self._namespace_order_enabled(ssn)
 
+        job_attrs = self.job_attrs
         for job in ssn.jobs.values():
             attr = _Attr()
             # job.allocated is the incrementally-maintained sum over the
             # allocated-status buckets — identical to the per-task walk
             # (drf.go:84-90) at O(1) per job
-            attr.allocated.add(job.allocated)
-            self._update_share(attr)
-            self.job_attrs[job.uid] = attr
+            alloc = job.allocated
+            if alloc.milli_cpu == 0.0 and alloc.memory == 0.0 and \
+                    not any((alloc.scalar_resources or {}).values()):
+                # exactly-zero allocation: share is 0 with no dominant
+                # resource, which is _Attr()'s initial state — skip the
+                # copy and the share scan (the common all-pending regime)
+                job_attrs[job.uid] = attr
+            else:
+                attr.allocated.add(alloc)
+                self._update_share(attr)
+                job_attrs[job.uid] = attr
 
             if namespace_order_enabled:
                 ns_opt = self.namespace_opts.setdefault(job.namespace, _Attr())

@@ -111,7 +111,8 @@ def _remote(args):
         print("error: this subcommand needs --server host:port "
               "(a cluster process run with --api-address)", file=sys.stderr)
         return None
-    return RemoteStore(args.server)
+    return RemoteStore(args.server, token=args.token or None,
+                       tls_verify=not args.insecure_skip_tls_verify)
 
 
 def run_remote(args) -> int:
@@ -159,6 +160,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="vcctl")
     ap.add_argument("--server", default="",
                     help="cluster API gateway host:port (remote mode)")
+    ap.add_argument("--token", default="",
+                    help="bearer token for a gateway started with --api-token")
+    ap.add_argument("--insecure-skip-tls-verify", action="store_true",
+                    help="accept self-signed gateway certificates (https)")
     sub = ap.add_subparsers(dest="command", required=True)
 
     demo_p = sub.add_parser("demo", help="run a full in-process cluster demo")

@@ -61,6 +61,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--api-address", default="",
                     help="serve the store API gateway (vcctl --server "
                          "target) on this address; ':0' picks a free port")
+    ap.add_argument("--api-token", default="",
+                    help="require 'Authorization: Bearer <token>' on every "
+                         "gateway request (mandatory for non-loopback "
+                         "--api-address)")
+    ap.add_argument("--api-tls-cert", default="",
+                    help="serve the gateway over HTTPS with this cert chain")
+    ap.add_argument("--api-tls-key", default="",
+                    help="private key for --api-tls-cert")
     ap.add_argument("--run-for", type=float, default=0.0,
                     help="exit after N seconds (0 = until SIGINT)")
     ap.add_argument("--version", action="store_true")
@@ -157,7 +165,11 @@ def main(argv=None) -> int:
     if args.api_address:
         from volcano_tpu.store.gateway import ApiGateway
 
-        api_srv = ApiGateway(cluster.store, args.api_address).start()
+        api_srv = ApiGateway(
+            cluster.store, args.api_address,
+            token=args.api_token or None,
+            tls_cert=args.api_tls_cert or None,
+            tls_key=args.api_tls_key or None).start()
         # the flush=True print is the port-discovery contract for tools
         # spawning this process with --api-address :0
         print(f"api gateway on :{api_srv.port}", flush=True)

@@ -13,11 +13,29 @@ cluster process from outside:
     PUT    /apis/{Kind}/{ns}/{name}?expect=  update   (CAS via expect)
     DELETE /apis/{Kind}/{ns}/{name}          delete
     GET    /events/{Kind}/{ns}/{name}        recorded events
+    GET    /watch/{Kind}?since=&timeout=     long-poll watch stream
     GET    /healthz
 
 Admission runs server-side exactly as for in-process writes (store.create
 applies mutators/validators); AdmissionError maps to 422, ConflictError
 to 409, NotFoundError to 404. Objects travel as api/codec.py envelopes.
+
+Watch streams make remote informer clients possible — the reference's
+controllers/scheduler are informer clients of the API server
+(pkg/scheduler/cache/cache.go:322-425); RemoteStore.watch (store/remote.py)
+long-polls this endpoint and dispatches the same WatchHandler callbacks as
+the in-process Store.watch. Protocol: each kind gets a server-side journal
+(created on first watch, seeded with ADDED for existing objects); clients
+poll `since=<seq>` and receive `{"events": [...], "next": seq}`; a client
+that fell behind a trimmed journal receives `{"reset": true, "next": seq}`
+and must re-list before resuming.
+
+Auth/TLS: pass ``token=`` to require `Authorization: Bearer <token>` on
+every request except /healthz (the reference's API surface is an
+authenticated TLS server — pkg/admission/server.go:33-62); pass
+``tls_cert=/tls_key=`` to serve HTTPS. A non-loopback bind without a token
+is refused at start() — exposing an unauthenticated read-write API beyond
+the host must be impossible by accident.
 """
 
 from __future__ import annotations
@@ -26,15 +44,73 @@ import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, Optional
 from urllib.parse import parse_qs, urlsplit
 
 from volcano_tpu.api import codec
 from volcano_tpu.scheduler.httpserver import _parse_address
 from volcano_tpu.store.store import (
-    AdmissionError, ConflictError, NotFoundError, Store)
+    AdmissionError, ConflictError, NotFoundError, Store, WatchHandler)
 
 logger = logging.getLogger(__name__)
+
+
+class _WatchJournal:
+    """Per-kind ring buffer of watch events, fed by a store WatchHandler.
+
+    Seeded with ADDED entries for existing objects at creation (the
+    list+watch initial sync), so a client polling from since=0 sees the
+    full state. Trimmed at ``cap``; a reader whose cursor predates the
+    ring start gets reset=True and must re-list."""
+
+    def __init__(self, store: Store, kind: str, cap: int = 4096):
+        self.cond = threading.Condition()
+        self.events: list = []
+        self.start = 0  # sequence number of events[0]
+        self.cap = cap
+        store.watch(kind, WatchHandler(
+            added=lambda new: self._append("ADDED", None, new),
+            updated=lambda old, new: self._append("MODIFIED", old, new),
+            deleted=lambda old: self._append("DELETED", old, None),
+        ), replay=True)
+
+    def _append(self, etype: str, old, new) -> None:
+        entry = {"type": etype}
+        if new is not None:
+            entry["object"] = codec.envelope(new)
+        if old is not None:
+            entry["old"] = codec.envelope(old)
+        with self.cond:
+            self.events.append(entry)
+            if len(self.events) > self.cap:
+                drop = len(self.events) - self.cap
+                del self.events[:drop]
+                self.start += drop
+            self.cond.notify_all()
+
+    def poll(self, since: int, timeout: float):
+        """Events with seq >= since, blocking up to ``timeout`` when none
+        are pending. Returns (events, next_seq, reset)."""
+        deadline = None
+        with self.cond:
+            while True:
+                end = self.start + len(self.events)
+                if since < self.start:
+                    return [], end, True  # fell behind the ring: re-list
+                if since < end:
+                    return list(self.events[since - self.start:]), end, False
+                if deadline is None:
+                    import time as _time
+
+                    deadline = _time.monotonic() + timeout
+                    remaining = timeout
+                else:
+                    import time as _time
+
+                    remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return [], end, False
+                self.cond.wait(remaining)
 
 
 class ApiGateway:
@@ -44,11 +120,19 @@ class ApiGateway:
     UNAUTHENTICATED read-write API — exposing it beyond the host must be
     an explicit operator choice (--api-address 0.0.0.0:PORT)."""
 
-    def __init__(self, store: Store, address: str = ":0"):
+    def __init__(self, store: Store, address: str = ":0",
+                 token: Optional[str] = None,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None):
         self.store = store
         self._address = _parse_address(address, default_host="127.0.0.1")
+        self._token = token
+        self._tls_cert = tls_cert
+        self._tls_key = tls_key
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._journals: Dict[str, _WatchJournal] = {}
+        self._journals_lock = threading.Lock()
 
     @property
     def port(self) -> int:
@@ -56,8 +140,22 @@ class ApiGateway:
             raise RuntimeError("gateway not started")
         return self._httpd.server_address[1]
 
+    def _journal(self, kind: str) -> _WatchJournal:
+        with self._journals_lock:
+            j = self._journals.get(kind)
+            if j is None:
+                j = self._journals[kind] = _WatchJournal(self.store, kind)
+            return j
+
     def start(self) -> "ApiGateway":
         store = self.store
+        gw = self
+        token = self._token
+        host = self._address[0]
+        if token is None and host not in ("127.0.0.1", "localhost", "::1", ""):
+            raise ValueError(
+                f"refusing to bind unauthenticated gateway on {host!r}: "
+                "a non-loopback --api-address requires --api-token")
 
         class Handler(BaseHTTPRequestHandler):
             def _reply(self, code: int, payload) -> None:
@@ -86,8 +184,23 @@ class ApiGateway:
                     parts.query, keep_blank_values=True).items()}
                 return segs, q
 
+            def _authorized(self, segs) -> bool:
+                """Bearer-token gate on every route except /healthz."""
+                if token is None or segs == ["healthz"]:
+                    return True
+                import hmac
+
+                supplied = self.headers.get("Authorization", "")
+                if hmac.compare_digest(supplied, f"Bearer {token}"):
+                    return True
+                self._reply(401, {"error": "missing or invalid bearer token",
+                                  "type": "Unauthorized"})
+                return False
+
             def do_GET(self):  # noqa: N802 (http.server API)
                 segs, q = self._route()
+                if not self._authorized(segs):
+                    return
                 try:
                     if segs == ["healthz"]:
                         self._reply(200, {"ok": True})
@@ -95,13 +208,35 @@ class ApiGateway:
                         ns = q.get("namespace")
                         selector = None
                         if q.get("selector"):
-                            selector = dict(
-                                kv.split("=", 1)
-                                for kv in q["selector"].split(","))
+                            try:
+                                selector = dict(
+                                    kv.split("=", 1)
+                                    for kv in q["selector"].split(","))
+                            except ValueError:
+                                self._reply(400, {
+                                    "error": "malformed selector: expected "
+                                             "k=v[,k=v...]",
+                                    "type": "ValueError"})
+                                return
                         items = store.list(segs[1], namespace=ns,
                                            selector=selector)
                         self._reply(200, {"items": [
                             codec.envelope(o) for o in items]})
+                    elif len(segs) == 2 and segs[0] == "watch":
+                        try:
+                            since = int(q.get("since", "0"))
+                            timeout = min(float(q.get("timeout", "30")), 60.0)
+                        except ValueError:
+                            self._reply(400, {
+                                "error": "since/timeout must be numeric",
+                                "type": "ValueError"})
+                            return
+                        events, nxt, reset = gw._journal(segs[1]).poll(
+                            since, timeout)
+                        payload = {"events": events, "next": nxt}
+                        if reset:
+                            payload["reset"] = True
+                        self._reply(200, payload)
                     elif len(segs) == 4 and segs[0] == "apis":
                         ns = "" if segs[2] == "-" else segs[2]
                         obj = store.get(segs[1], ns, segs[3])
@@ -123,6 +258,8 @@ class ApiGateway:
 
             def do_POST(self):  # noqa: N802
                 segs, _ = self._route()
+                if not self._authorized(segs):
+                    return
                 try:
                     if len(segs) == 2 and segs[0] == "apis":
                         obj = codec.from_envelope(self._body())
@@ -148,9 +285,26 @@ class ApiGateway:
 
             def do_PUT(self):  # noqa: N802
                 segs, q = self._route()
+                if not self._authorized(segs):
+                    return
                 try:
                     if len(segs) == 4 and segs[0] == "apis":
                         obj = codec.from_envelope(self._body())
+                        # the path names the update target; a body whose
+                        # metadata disagrees would silently update a
+                        # DIFFERENT object — reject instead
+                        ns = "" if segs[2] == "-" else segs[2]
+                        body_ns = getattr(obj.metadata, "namespace", "") or ""
+                        if type(obj).KIND != segs[1] \
+                                or obj.metadata.name != segs[3] \
+                                or (body_ns != ns and segs[2] != "-"):
+                            self._reply(400, {
+                                "error": "path/body mismatch: path names "
+                                         f"{segs[1]}/{segs[2]}/{segs[3]}, body "
+                                         f"names {type(obj).KIND}/"
+                                         f"{body_ns or '-'}/{obj.metadata.name}",
+                                "type": "ValueError"})
+                            return
                         expect = (int(q["expect"])
                                   if "expect" in q else None)
                         updated = store.update(obj, expect_version=expect)
@@ -169,6 +323,8 @@ class ApiGateway:
 
             def do_DELETE(self):  # noqa: N802
                 segs, _ = self._route()
+                if not self._authorized(segs):
+                    return
                 try:
                     if len(segs) == 4 and segs[0] == "apis":
                         ns = "" if segs[2] == "-" else segs[2]
@@ -186,6 +342,13 @@ class ApiGateway:
                 logger.debug("gateway: " + fmt, *args)
 
         self._httpd = ThreadingHTTPServer(self._address, Handler)
+        if self._tls_cert:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self._tls_cert, self._tls_key)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="volcano-api-gateway")

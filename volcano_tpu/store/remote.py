@@ -6,6 +6,13 @@ delete / get / list / events_for) against a store gateway
 cluster process unchanged — the networked counterpart of the reference's
 vcctl-to-API-server client (cmd/cli/vcctl.go:34; pkg/cli/job/run.go:55-80).
 
+Also implements ``watch``: a background long-poll thread per watched kind
+dispatches the same informer-style WatchHandler callbacks as the
+in-process Store.watch, which makes CONTROLLERS network-capable — a
+controller process can run outside the cluster process exactly like the
+reference's informer clients of the API server
+(pkg/scheduler/cache/cache.go:322-425).
+
 Errors map back to the store's exception types (NotFoundError /
 ConflictError / AdmissionError), so callers cannot tell the difference.
 """
@@ -13,6 +20,8 @@ ConflictError / AdmissionError), so callers cannot tell the difference.
 from __future__ import annotations
 
 import json
+import logging
+import threading
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -20,7 +29,10 @@ from typing import Dict, List, Optional
 
 from volcano_tpu.api import codec
 from volcano_tpu.store.store import (
-    CLUSTER_SCOPED, AdmissionError, ConflictError, NotFoundError)
+    CLUSTER_SCOPED, AdmissionError, ConflictError, NotFoundError,
+    WatchHandler)
+
+logger = logging.getLogger(__name__)
 
 CLUSTER_SCOPED_PLACEHOLDER = "-"
 
@@ -41,25 +53,45 @@ class RemoteEvent:
 
 
 class RemoteStore:
-    def __init__(self, server: str, timeout: float = 10.0):
+    def __init__(self, server: str, timeout: float = 10.0,
+                 token: Optional[str] = None,
+                 tls_verify: bool = True):
         if "://" not in server:
             server = "http://" + server
         self.base = server.rstrip("/")
         self.timeout = timeout
+        self.token = token
+        self._ssl_ctx = None
+        if not tls_verify:
+            import ssl
+
+            # self-signed test deployments: the operator opts out of
+            # verification explicitly (mirrors kubeconfig insecure-skip)
+            self._ssl_ctx = ssl.create_default_context()
+            self._ssl_ctx.check_hostname = False
+            self._ssl_ctx.verify_mode = ssl.CERT_NONE
+        self._watch_stop = threading.Event()
+        self._watch_threads: List[threading.Thread] = []
 
     # -- transport ---------------------------------------------------------
 
     def _request(self, method: str, path: str, payload: Optional[dict] = None,
-                 query: Optional[Dict[str, str]] = None) -> dict:
+                 query: Optional[Dict[str, str]] = None,
+                 timeout: Optional[float] = None) -> dict:
         url = self.base + path
         if query:
             url += "?" + urllib.parse.urlencode(query)
         data = json.dumps(payload).encode() if payload is not None else None
+        headers = {"Content-Type": "application/json"}
+        if self.token is not None:
+            headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            url, data=data, method=method, headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=timeout if timeout is not None
+                    else self.timeout,
+                    context=self._ssl_ctx) as resp:
                 return json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:
             try:
@@ -78,6 +110,10 @@ class RemoteStore:
             raise RemoteStoreError(f"{method} {url}: {e.code} {msg}") from None
         except urllib.error.URLError as e:
             raise RemoteStoreError(f"{method} {url}: {e.reason}") from None
+        except OSError as e:
+            # transport-level failures below urllib's mapping (e.g. a
+            # plaintext client hitting a TLS port gets a raw reset)
+            raise RemoteStoreError(f"{method} {url}: {e}") from None
 
     @staticmethod
     def _ns_seg(namespace: str) -> str:
@@ -149,3 +185,88 @@ class RemoteStore:
             return bool(self._request("GET", "/healthz").get("ok"))
         except Exception:
             return False
+
+    # -- watch (informer twin) ----------------------------------------------
+
+    def watch(self, kind: str, handler: WatchHandler,
+              replay: bool = True, poll_timeout: float = 20.0) -> None:
+        """Long-poll the gateway's /watch/{kind} journal on a background
+        thread, dispatching the in-process WatchHandler callbacks.
+
+        The journal's initial sync already delivers existing objects as
+        ADDED (gateway _WatchJournal seeds on creation), so ``replay``
+        is honored by starting from seq 0; ``replay=False`` starts from
+        the journal's current head. On a journal reset (client fell
+        behind the ring buffer) the poller re-lists the kind and
+        re-delivers current objects as ADDED — the same at-least-once
+        semantic informer resyncs have; handlers must be idempotent on
+        re-ADDs, which the store-backed caches/controllers are.
+
+        Callbacks run on the poll thread — the same "handler runs on a
+        foreign thread" contract as the in-process store, whose handlers
+        run on the writer's thread."""
+        since = 0
+        if not replay:
+            out = self._request("GET", f"/watch/{kind}",
+                                query={"since": "0", "timeout": "0"})
+            since = int(out.get("next", 0))
+
+        # capture THIS registration's stop event: stop_watches replaces
+        # the attribute, so a still-draining old poller must keep seeing
+        # its own (set) event rather than resurrecting on the fresh one
+        stop = self._watch_stop
+
+        def _loop(since=since):
+            while not stop.is_set():
+                try:
+                    out = self._request(
+                        "GET", f"/watch/{kind}",
+                        query={"since": str(since),
+                               "timeout": str(poll_timeout)},
+                        timeout=poll_timeout + self.timeout)
+                except Exception as e:
+                    if stop.is_set():
+                        return
+                    logger.warning("watch %s poll failed (%s); retrying", kind, e)
+                    stop.wait(1.0)
+                    continue
+                if out.get("reset"):
+                    since = int(out.get("next", 0))
+                    try:
+                        for obj in self.list(kind):
+                            if handler.added is not None:
+                                handler.added(obj)
+                    except Exception as e:
+                        logger.warning("watch %s re-list failed: %s", kind, e)
+                    continue
+                for entry in out.get("events", []):
+                    try:
+                        etype = entry.get("type")
+                        new = (codec.from_envelope(entry["object"])
+                               if "object" in entry else None)
+                        old = (codec.from_envelope(entry["old"])
+                               if "old" in entry else None)
+                        if etype == "ADDED" and handler.added is not None:
+                            handler.added(new)
+                        elif etype == "MODIFIED" and handler.updated is not None:
+                            handler.updated(old, new)
+                        elif etype == "DELETED" and handler.deleted is not None:
+                            handler.deleted(old)
+                    except Exception:
+                        logger.exception("watch %s handler failed", kind)
+                since = int(out.get("next", since))
+
+        t = threading.Thread(target=_loop, daemon=True,
+                             name=f"remote-watch-{kind}")
+        t.start()
+        self._watch_threads.append(t)
+
+    def stop_watches(self) -> None:
+        """Signal and join the watch poll threads (in-flight long-polls
+        finish their server-side timeout or error out). A later watch()
+        starts fresh — the stop event is replaced, not left set."""
+        self._watch_stop.set()
+        for t in self._watch_threads:
+            t.join(timeout=2)
+        self._watch_threads = []
+        self._watch_stop = threading.Event()

@@ -96,7 +96,7 @@ def _session_once(cache, tiers, actions, mesh=None):
 
 
 def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
-               mesh=None, verbose=True, warm_iters: int = 3):
+               mesh=None, verbose=True, warm_iters: int = 5):
     warm_iters = max(warm_iters, 1)
     from volcano_tpu.bench.clusters import CONFIGS, build_config
 
@@ -255,8 +255,10 @@ def main() -> int:
     ap.add_argument("--backend", choices=["serial", "tpu", "both", "auto"], default="auto")
     ap.add_argument("--serial-budget", type=float, default=30.0,
                     help="max seconds to spend measuring the serial loop per config")
-    ap.add_argument("--warm-iters", type=int, default=3,
-                    help="warm TPU sessions per config (>=1); min is reported")
+    ap.add_argument("--warm-iters", type=int, default=5,
+                    help="warm TPU sessions per config (>=1); the headline "
+                         "binds on the MEDIAN e2e, and 5 samples keep one "
+                         "link-jitter outlier from dragging it")
     ap.add_argument("--mesh", action="store_true",
                     help="shard the node axis across all local devices")
     args = ap.parse_args()

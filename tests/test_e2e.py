@@ -120,6 +120,72 @@ class TestMPIRendezvous:
         assert {p.spec.subdomain for p in pods} == {"lm-mpi-job"}
 
 
+class TestPSWorkerRendezvous:
+    def test_tf_style_ps_worker_job(self):
+        """The reference's distributed-TF e2e (test/e2e/tensorflow.go:123):
+        a job with HETEROGENEOUS task groups — ps x2 + worker x4 — using
+        the env and svc plugins. Every pod gets its per-group VK_TASK_INDEX
+        and a stable DNS identity; the svc ConfigMap carries a hostfile PER
+        GROUP (the TF_CONFIG cluster-spec analog); gang scheduling blocks
+        the WHOLE job until both groups fit."""
+        # one 4-cpu node: the 6-pod gang needs 6 cpu total, so nothing may
+        # bind until more capacity arrives
+        cluster = make_cluster(nodes=1, cpu="4", mem="8Gi")
+        job = make_job(
+            name="dist-mnist", min_available=6,
+            tasks=(("ps", 2), ("worker", 4)),
+            plugins={"env": [], "svc": []})
+        job.spec.scheduler_name = "volcano"
+        cluster.store.create(job)
+        cluster.settle(4)
+
+        # gang-blocked: 6 x 1cpu > 4 cpu — no pod of EITHER group binds
+        bound = [p for p in cluster.store.list("Pod", namespace="ns1")
+                 if p.spec.node_name]
+        assert bound == [], "gang must stay whole while capacity is short"
+        pg = cluster.store.get("PodGroup", "ns1", "dist-mnist")
+        assert pg.status.phase != objects.PodGroupPhase.RUNNING
+
+        # capacity arrives -> the whole heterogeneous gang binds at once
+        cluster.store.create(build_node(
+            "node-late", build_resource_list_with_pods("8", "16Gi")))
+        cluster.settle(5)
+        pods = cluster.store.list("Pod", namespace="ns1")
+        assert len(pods) == 6
+        assert all(p.spec.node_name for p in pods)
+        assert all(p.status.phase == objects.POD_PHASE_RUNNING for p in pods)
+
+        # per-group hostfiles in the svc ConfigMap (tensorflow.go's
+        # cluster-spec rendezvous: ps hosts + worker hosts, separately)
+        cm = cluster.store.get("ConfigMap", "ns1", "dist-mnist-svc")
+        assert cm.data["ps.host"].splitlines() == [
+            "dist-mnist-ps-0.dist-mnist",
+            "dist-mnist-ps-1.dist-mnist",
+        ]
+        assert cm.data["worker.host"].splitlines() == [
+            f"dist-mnist-worker-{i}.dist-mnist" for i in range(4)
+        ]
+
+        # VK_TASK_INDEX: per-group replica index, 0..N-1 within each group
+        by_group = {}
+        for p in pods:
+            group = p.metadata.annotations[objects.TASK_SPEC_KEY]
+            env = {e.name: e.value for c in p.spec.containers
+                   for e in c.env}
+            by_group.setdefault(group, []).append(int(env["VK_TASK_INDEX"]))
+        assert sorted(by_group["ps"]) == [0, 1]
+        assert sorted(by_group["worker"]) == [0, 1, 2, 3]
+
+        # stable DNS identity for the TF_CONFIG addresses
+        assert {p.spec.subdomain for p in pods} == {"dist-mnist"}
+        assert {p.spec.hostname for p in pods} == {p.metadata.name for p in pods}
+
+        # all pods (ps + workers) completing completes the job
+        finish_pods(cluster)
+        cluster.settle(3)
+        assert job_state(cluster, "dist-mnist") == JobPhase.COMPLETED
+
+
 class TestLifecyclePolicies:
     def test_pod_failure_restarts_and_reschedules(self):
         cluster = make_cluster()

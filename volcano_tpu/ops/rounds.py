@@ -542,7 +542,11 @@ def solve_rounds_packed(spec: SolveSpec, layout, bufs):
     }
     assign, n_rounds, tail_placed = solve_rounds.__wrapped__(spec, enc)
     n_total = enc["node_idle"].shape[0]
-    tail = jnp.stack([n_rounds & 0x7FFF, n_rounds >> 15, tail_placed])
+    # tail_placed is bounded by 8*round_min_progress+16; clamp to the
+    # int16 limb's range so an extreme round_min_progress config can't
+    # silently wrap the PROFILE counter (assignments are unaffected)
+    tail = jnp.stack([n_rounds & 0x7FFF, n_rounds >> 15,
+                      jnp.minimum(tail_placed, 0x7FFF)])
     if n_total <= 32766:  # static (trace-time) shape decision
         return jnp.concatenate([assign.astype(jnp.int16),
                                 tail.astype(jnp.int16)])
@@ -780,7 +784,6 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         sweep cannot place are retired with assign -1 (the kernel's mask
         equals the serial predicate verdict for modeled tasks); gangs left
         short are stripped and re-enqueued below exactly as before."""
-        big_rank = jnp.int32(2**30)
         tail_budget = jnp.int32(8 * max(spec.round_min_progress, 1) + 16)
 
         def cond(s):
@@ -797,9 +800,37 @@ def solve_rounds(spec: SolveSpec, enc: dict):
                 over = ~_le_eps_rows(s["queue_alloc"], enc["queue_deserved"],
                                      enc["eps"], enc["is_scalar"])
                 eligible = eligible & ~over[task_queue]
-            job_rank = _job_rank(spec, enc, s["job_placed"], s["job_alloc"])
-            task_rank = job_rank[task_job] * max_tasks_per_job + task_in_job
-            t = jnp.argmin(jnp.where(eligible, task_rank, big_rank))
+            # lexicographic argmin over the SAME job-order keys _job_rank
+            # sorts by, without the per-step [J] lexsort (sorts are the
+            # expensive primitive on TPU; ~245 tail steps each paid one).
+            # A chain of masked min-reductions selects the identical task:
+            # narrow the candidate set one key level at a time, then take
+            # the first surviving index — exactly lexsort-rank order with
+            # the task_in_job tie-break.
+            levels = []
+            for name in spec.job_order_keys:
+                if name == "priority":
+                    levels.append((-enc["job_priority"])[task_job])
+                elif name == "gang":
+                    ready = ((enc["job_ready_base"] + s["job_placed"])
+                             >= enc["job_min_available"])
+                    levels.append(ready.astype(jnp.int32)[task_job])
+                elif name == "drf":
+                    share = _share(s["job_alloc"],
+                                   enc["drf_total"][None, :],
+                                   enc["drf_present"][None, :])
+                    levels.append(share[task_job])
+            levels.append(enc["job_tie_rank"][task_job])
+            levels.append(task_in_job)
+            cand = eligible
+            for lv in levels:
+                if jnp.issubdtype(lv.dtype, jnp.floating):
+                    sentinel = jnp.array(jnp.inf, lv.dtype)
+                else:
+                    sentinel = jnp.array(jnp.iinfo(lv.dtype).max, lv.dtype)
+                m = jnp.min(jnp.where(cand, lv, sentinel))
+                cand = cand & (lv == m)
+            t = jnp.argmax(cand)  # first-True == lowest task index
             has = jnp.any(eligible)
             c = enc["task_cls"][t]
             req = enc["cls_req"][c]

@@ -362,7 +362,12 @@ class BatchAllocator:
                 self.profile["rounds"] = int(n_rounds)
                 if tail_placed:
                     # diminishing-returns cap fired and the device tail
-                    # placed the stragglers (rounds.py tail_pass)
+                    # placed the stragglers (rounds.py tail_pass). This is
+                    # a count of tail placement ATTEMPTS: the post-tail
+                    # gang-atomicity strip may later revoke placements of
+                    # gangs that stayed short, and those revocations are
+                    # not subtracted here — treat as an upper bound on
+                    # tail contribution, not a net figure
                     self.profile["tail_placed"] = tail_placed
             else:
                 assign, rr = kernels.solve_allocate(

@@ -115,8 +115,10 @@ class TestMPIRendezvous:
         pods = cluster.store.list("Pod", namespace="ns1")
         assert len(pods) == 3
         assert all(p.status.phase == objects.POD_PHASE_RUNNING for p in pods)
-        # every pod has a stable DNS identity for rendezvous
-        assert {p.spec.hostname for p in pods} == {p.metadata.name for p in pods}
+        # every pod has a stable DNS identity for rendezvous (per pod: a
+        # swapped-hostname bug cannot hide behind set equality)
+        for p in pods:
+            assert p.spec.hostname == p.metadata.name
         assert {p.spec.subdomain for p in pods} == {"lm-mpi-job"}
 
 
@@ -176,9 +178,11 @@ class TestPSWorkerRendezvous:
         assert sorted(by_group["ps"]) == [0, 1]
         assert sorted(by_group["worker"]) == [0, 1, 2, 3]
 
-        # stable DNS identity for the TF_CONFIG addresses
+        # stable DNS identity for the TF_CONFIG addresses — per pod, so a
+        # swapped-hostname indexing bug cannot hide behind set equality
         assert {p.spec.subdomain for p in pods} == {"dist-mnist"}
-        assert {p.spec.hostname for p in pods} == {p.metadata.name for p in pods}
+        for p in pods:
+            assert p.spec.hostname == p.metadata.name
 
         # all pods (ps + workers) completing completes the job
         finish_pods(cluster)

@@ -69,9 +69,27 @@ class JobUpdater:
         if job.pod_group is None:
             ssn.cache.record_job_status_event(job)
             return
-        job.pod_group.status = session_mod.job_status(ssn, job)
+        # job_status clones the whole PodGroupStatus to rewrite 4 fields;
+        # when the computed values already equal the live status (the
+        # common case for jobs a session didn't touch), the clone+assign
+        # is value-neutral — keep the current object and skip it.
+        # job_status itself never modifies conditions, so field equality
+        # IS value equality here.
+        cur = job.pod_group.status
+        phase, running, failed, succeeded = session_mod.job_status_values(
+            ssn, job)
+        if (phase == cur.phase and running == cur.running
+                and failed == cur.failed and succeeded == cur.succeeded):
+            new_status = cur
+        else:
+            new_status = cur.clone()
+            new_status.phase = phase
+            new_status.running = running
+            new_status.failed = failed
+            new_status.succeeded = succeeded
+            job.pod_group.status = new_status
         old_status = ssn.pod_group_status.get(job.uid)
         update_pg = old_status is None or is_pod_group_status_updated(
-            job.pod_group.status, old_status
+            new_status, old_status
         )
         ssn.cache.update_job_status(job, update_pg)

@@ -549,33 +549,45 @@ class Session:
         job.pod_group.status.conditions.append(cond)
 
 
-def job_status(ssn: Session, job_info: JobInfo) -> objects.PodGroupStatus:
-    """Compute the PodGroup status to write back at session close
-    (session.go:157-195)."""
-    status = job_info.pod_group.status.clone()
-
+def job_status_values(ssn: Session, job_info: JobInfo):
+    """The (phase, running, failed, succeeded) a session-close writeback
+    would set (session.go:157-195) — the value half of job_status, without
+    materializing the status clone (JobUpdater skips the clone when these
+    equal the live status)."""
+    idx = job_info.task_status_index
+    cur = job_info.pod_group.status
     unschedulable = any(
         c.type == objects.POD_GROUP_UNSCHEDULABLE_TYPE
         and c.status == "True"
         and c.transition_id == ssn.uid
-        for c in status.conditions
+        for c in cur.conditions
     )
 
-    if job_info.task_status_index.get(TaskStatus.RUNNING) and unschedulable:
-        status.phase = objects.PodGroupPhase.UNKNOWN
+    phase = cur.phase
+    if idx.get(TaskStatus.RUNNING) and unschedulable:
+        phase = objects.PodGroupPhase.UNKNOWN
     else:
         allocated = 0
-        for st, tasks in job_info.task_status_index.items():
+        for st, tasks in idx.items():
             if allocated_status(st) or st == TaskStatus.SUCCEEDED:
                 allocated += len(tasks)
         if allocated >= job_info.pod_group.spec.min_member:
-            status.phase = objects.PodGroupPhase.RUNNING
-        elif job_info.pod_group.status.phase != objects.PodGroupPhase.INQUEUE:
-            status.phase = objects.PodGroupPhase.PENDING
+            phase = objects.PodGroupPhase.RUNNING
+        elif cur.phase != objects.PodGroupPhase.INQUEUE:
+            phase = objects.PodGroupPhase.PENDING
 
-    status.running = len(job_info.task_status_index.get(TaskStatus.RUNNING, {}))
-    status.failed = len(job_info.task_status_index.get(TaskStatus.FAILED, {}))
-    status.succeeded = len(job_info.task_status_index.get(TaskStatus.SUCCEEDED, {}))
+    return (phase,
+            len(idx.get(TaskStatus.RUNNING, {})),
+            len(idx.get(TaskStatus.FAILED, {})),
+            len(idx.get(TaskStatus.SUCCEEDED, {})))
+
+
+def job_status(ssn: Session, job_info: JobInfo) -> objects.PodGroupStatus:
+    """Compute the PodGroup status to write back at session close
+    (session.go:157-195)."""
+    status = job_info.pod_group.status.clone()
+    (status.phase, status.running, status.failed,
+     status.succeeded) = job_status_values(ssn, job_info)
     return status
 
 

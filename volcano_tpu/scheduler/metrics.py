@@ -144,8 +144,8 @@ def update_preemption_victims(n: int) -> None:
     registry().preemption_victims.inc(value=n)
 
 
-def register_preemption_attempts() -> None:
-    registry().preemption_attempts.inc()
+def register_preemption_attempts(n: int = 1) -> None:
+    registry().preemption_attempts.inc(value=n)
 
 
 def update_unschedule_task_count(job_id: str, n: int) -> None:

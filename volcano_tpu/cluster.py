@@ -111,11 +111,19 @@ class Cluster:
 
     # -- threaded drive ----------------------------------------------------
 
-    def run(self) -> None:
-        self._ensure_cache()
+    def run(self, scheduling: bool = True) -> None:
+        """Start the threaded loops. With ``scheduling=False`` the process
+        runs as an API-server analog — store + admission + controllers +
+        kubelet + (externally) the gateway — and an out-of-process
+        scheduler consumes it over RemoteStore watches, the reference's
+        vc-scheduler-vs-API-server topology."""
+        if scheduling:
+            self._ensure_cache()
         self._threaded = True
+        self._scheduling = scheduling
         self.job_controller.run()
-        self.scheduler.run()
+        if scheduling:
+            self.scheduler.run()
         import threading
 
         self._kubelet_stop = threading.Event()
@@ -135,6 +143,7 @@ class Cluster:
         if self._threaded:
             self._kubelet_stop.set()
             self._kubelet_thread.join(timeout=5.0)
-            self.scheduler.stop()
+            if getattr(self, "_scheduling", True):
+                self.scheduler.stop()
             self.job_controller.stop()
             self._threaded = False

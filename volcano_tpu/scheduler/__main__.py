@@ -69,6 +69,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="serve the gateway over HTTPS with this cert chain")
     ap.add_argument("--api-tls-key", default="",
                     help="private key for --api-tls-cert")
+    ap.add_argument("--api-server-only", action="store_true",
+                    help="run store + admission + controllers + kubelet + "
+                         "gateway WITHOUT the in-process scheduler: an "
+                         "out-of-process scheduler consumes this process "
+                         "over RemoteStore watches (use with "
+                         "--api-address)")
     ap.add_argument("--run-for", type=float, default=0.0,
                     help="exit after N seconds (0 = until SIGINT)")
     ap.add_argument("--version", action="store_true")
@@ -190,12 +196,13 @@ def main(argv=None) -> int:
             args.scheduler_name, identity)
         elector = LeaderElector(
             lock,
-            on_started_leading=cluster.run,
+            on_started_leading=lambda: cluster.run(
+                scheduling=not args.api_server_only),
             on_stopped_leading=lambda: cluster.stop())
         elector.start()
         logging.info("leader election enabled (identity=%s)", identity)
     else:
-        cluster.run()
+        cluster.run(scheduling=not args.api_server_only)
 
     def on_signal(signum, frame):
         stop_evt.set()

@@ -261,7 +261,25 @@ class ApiGateway:
                 if not self._authorized(segs):
                     return
                 try:
-                    if len(segs) == 2 and segs[0] == "apis":
+                    if segs == ["events"]:
+                        # batched event ingestion from remote components
+                        # (a remote scheduler cache records Scheduled /
+                        # Unschedulable events here; the reference's
+                        # recorder is an async broadcaster to the API
+                        # server the same way)
+                        from volcano_tpu.store.store import RecordedEvent
+
+                        items = [
+                            RecordedEvent(
+                                object_kind=str(i["object_kind"]),
+                                object_key=str(i["object_key"]),
+                                event_type=str(i["event_type"]),
+                                reason=str(i["reason"]),
+                                message=str(i["message"]))
+                            for i in self._body().get("items", [])]
+                        store.record_events_raw(items)
+                        self._reply(200, {"recorded": len(items)})
+                    elif len(segs) == 2 and segs[0] == "apis":
                         obj = codec.from_envelope(self._body())
                         if type(obj).KIND != segs[1]:
                             self._reply(400, {

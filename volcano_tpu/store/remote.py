@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -72,6 +73,12 @@ class RemoteStore:
             self._ssl_ctx.verify_mode = ssl.CERT_NONE
         self._watch_stop = threading.Event()
         self._watch_threads: List[threading.Thread] = []
+        self._event_buf: List[dict] = []
+        self._event_lock = threading.Lock()
+        self._event_wake = threading.Event()
+        self._event_thread: Optional[threading.Thread] = None
+        self._event_stop = False
+        self._event_inflight = False
 
     # -- transport ---------------------------------------------------------
 
@@ -186,6 +193,89 @@ class RemoteStore:
         except Exception:
             return False
 
+    # -- events (async batched recorder) -------------------------------------
+
+    def _event_flusher(self) -> None:
+        while True:
+            self._event_wake.wait(0.5)
+            self._event_wake.clear()
+            with self._event_lock:
+                batch, self._event_buf = self._event_buf, []
+                stopping = self._event_stop
+                # in-flight marker: flush_events must not report drained
+                # while this batch is still crossing the wire
+                self._event_inflight = bool(batch)
+            if batch:
+                try:
+                    self._request("POST", "/events", {"items": batch})
+                except Exception as e:
+                    logger.warning("event flush dropped %d items: %s",
+                                   len(batch), e)
+                finally:
+                    with self._event_lock:
+                        self._event_inflight = False
+            if stopping:
+                with self._event_lock:
+                    drained = not self._event_buf
+                if drained:
+                    return
+
+    def _queue_events(self, items) -> None:
+        with self._event_lock:
+            self._event_buf.extend(items)
+            if self._event_thread is None:
+                self._event_thread = threading.Thread(
+                    target=self._event_flusher, daemon=True,
+                    name="remote-event-flush")
+                self._event_thread.start()
+            if len(self._event_buf) >= 512:
+                self._event_wake.set()
+
+    def record_event(self, obj, event_type: str, reason: str,
+                     message: str) -> None:
+        """Fire-and-forget event recording, batched onto a background
+        flusher — events are observability, and the reference's recorder
+        is an async broadcaster the same way; a per-event HTTP round trip
+        on the scheduler's critical path would be pathological."""
+        from volcano_tpu.store.store import object_key
+
+        self._queue_events([{
+            "object_kind": type(obj).KIND, "object_key": object_key(obj),
+            "event_type": event_type, "reason": reason, "message": message}])
+
+    def record_scheduled(self, keys, hosts) -> None:
+        """Bulk Pod-Scheduled events from pre-derived ns/name keys (the
+        bulk-apply writeback's batch seam)."""
+        self._queue_events([
+            {"object_kind": "Pod", "object_key": key,
+             "event_type": "Normal", "reason": "Scheduled",
+             "message": f"Successfully assigned {key} to {host}"}
+            for key, host in zip(keys, hosts)])
+
+    def flush_events(self, timeout: float = 5.0) -> None:
+        """Block until queued events have been POSTED (tests/shutdown) —
+        both the buffer and any in-flight batch must drain."""
+        deadline = time.monotonic() + timeout
+        self._event_wake.set()
+        while time.monotonic() < deadline:
+            with self._event_lock:
+                if not self._event_buf and not self._event_inflight:
+                    return
+            self._event_wake.set()
+            time.sleep(0.05)
+
+    def stop_events(self, timeout: float = 5.0) -> None:
+        """Final-drain and stop the event flusher thread."""
+        with self._event_lock:
+            t = self._event_thread
+            self._event_stop = True
+            self._event_thread = None
+        if t is not None:
+            self._event_wake.set()
+            t.join(timeout=timeout)
+        with self._event_lock:
+            self._event_stop = False
+
     # -- watch (informer twin) ----------------------------------------------
 
     def watch(self, kind: str, handler: WatchHandler,
@@ -270,3 +360,5 @@ class RemoteStore:
             t.join(timeout=2)
         self._watch_threads = []
         self._watch_stop = threading.Event()
+        # the de-facto shutdown call: drain and stop the event flusher too
+        self.stop_events()

@@ -284,6 +284,12 @@ class Store:
                 for obj, event_type, reason, message in items
             )
 
+    def record_events_raw(self, items) -> None:
+        """Bulk append of pre-built event records (RecordedEvent /
+        ScheduledEvent duck-types) — the gateway's event-ingestion seam."""
+        with self._lock:
+            self.events.extend(items)
+
     def record_scheduled(self, keys, hosts) -> None:
         """Bulk Pod-Scheduled events from pre-derived ns/name keys; the
         message is lazy (ScheduledEvent), so the cost per placement is one

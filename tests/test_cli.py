@@ -98,3 +98,13 @@ class TestQueueCli:
         row = out.strip().splitlines()[1].split()
         assert row[0] == "default"
         assert "1" in row  # running count
+
+
+def test_vcctl_version_subcommand(capsys):
+    """vcctl version (reference cmd/cli/vcctl.go versionCommand): the
+    Version/GitSHA/Built banner, exit 0."""
+    from volcano_tpu.cli.vcctl import main
+
+    assert main(["version"]) == 0
+    out = capsys.readouterr().out
+    assert "Version:" in out and "Git SHA:" in out and "Built At:" in out

@@ -42,7 +42,16 @@ def api_server_proc():
         text=True)
     port = None
     deadline = time.time() + 60
+    # select-gated reads: a plain readline() blocks with no timeout, so an
+    # alive-but-silent server would stall setup for the full --run-for
+    # window instead of failing at the deadline
+    import select
+
     while time.time() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [],
+                                    max(deadline - time.time(), 0.1))
+        if not ready:
+            break
         line = proc.stdout.readline()
         if not line:
             break

@@ -192,7 +192,15 @@ def main(argv=None) -> int:
     p.add_argument("-N", "--name", required=True)
     queue_sub.add_parser("list")
 
+    sub.add_parser("version", help="print version/build metadata "
+                                   "(vcctl version)")
+
     args = ap.parse_args(argv)
+    if args.command == "version":
+        from volcano_tpu import version
+
+        sys.stdout.write(version.version_string())
+        return 0
     if args.command == "demo":
         return demo(args)
     return run_remote(args)

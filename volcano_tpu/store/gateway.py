@@ -295,7 +295,8 @@ class ApiGateway:
                     self._error(422, e)
                 except ConflictError as e:
                     self._error(409, e)
-                except (ValueError, KeyError, json.JSONDecodeError) as e:
+                except (ValueError, KeyError, TypeError,
+                        json.JSONDecodeError) as e:
                     self._error(400, e)  # malformed envelope: client error
                 except Exception as e:  # noqa: BLE001
                     logger.exception("gateway POST %s failed", self.path)

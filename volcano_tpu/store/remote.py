@@ -206,6 +206,15 @@ class RemoteStore:
                 # while this batch is still crossing the wire
                 self._event_inflight = bool(batch)
             if batch:
+                for i in batch:
+                    # deferred Scheduled-message formatting (the lazy-
+                    # message twin of the in-process ScheduledEvent):
+                    # the scheduler's bulk-apply path queued (key, host)
+                    # only, off its critical path
+                    host = i.pop("_host", None)
+                    if host is not None:
+                        i["message"] = (f"Successfully assigned "
+                                        f"{i['object_key']} to {host}")
                 try:
                     self._request("POST", "/events", {"items": batch})
                 except Exception as e:
@@ -248,8 +257,7 @@ class RemoteStore:
         bulk-apply writeback's batch seam)."""
         self._queue_events([
             {"object_kind": "Pod", "object_key": key,
-             "event_type": "Normal", "reason": "Scheduled",
-             "message": f"Successfully assigned {key} to {host}"}
+             "event_type": "Normal", "reason": "Scheduled", "_host": host}
             for key, host in zip(keys, hosts)])
 
     def flush_events(self, timeout: float = 5.0) -> None:
@@ -273,6 +281,16 @@ class RemoteStore:
         if t is not None:
             self._event_wake.set()
             t.join(timeout=timeout)
+            if t.is_alive():
+                # join timed out (gateway hung mid-POST): leave
+                # _event_stop set so the zombie exits as soon as it
+                # drains, instead of running concurrently with a future
+                # flusher and clobbering the shared in-flight flag; a
+                # later record_event still flushes (its fresh thread
+                # posts the batch and exits on the drained check)
+                logger.warning("event flusher did not stop within %.1fs",
+                               timeout)
+                return
         with self._event_lock:
             self._event_stop = False
 

@@ -153,3 +153,45 @@ def test_out_of_process_scheduler_binds_over_http(api_server_proc):
         assert evs, "Scheduled event never landed across the wire"
     finally:
         remote.stop_watches()
+
+
+def test_remote_scheduler_binary_mode(api_server_proc):
+    """The CLI form of the split: `python -m volcano_tpu.scheduler
+    --server host:port` as a THIRD process schedules jobs submitted
+    through the gateway (reference: vc-scheduler binary vs API server)."""
+    _, port = api_server_proc
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("VOLCANO_TPU_PANIC", None)
+    sched = subprocess.Popen(
+        [sys.executable, "-m", "volcano_tpu.scheduler",
+         "--server", f"127.0.0.1:{port}",
+         "--listen-address", ":0", "--healthz-address", "127.0.0.1:0",
+         "--schedule-period", "0.2", "--run-for", "60"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    remote = RemoteStore(f"127.0.0.1:{port}")
+    try:
+        from volcano_tpu.cli import job as job_cli
+
+        with open(os.path.join(REPO, "example", "job.yaml")) as f:
+            job_cli.run_job(remote, f.read().replace(
+                "name: test-job", "name: binary-job"))
+
+        def all_bound():
+            # an immediately-dead scheduler binary must fail the test NOW
+            # with its output, not after the full wait budget
+            assert sched.poll() is None, \
+                f"scheduler binary exited early:\n{sched.stdout.read()}"
+            pods = remote.list("Pod", namespace="default")
+            return pods if pods and all(p.spec.node_name for p in pods) \
+                else None
+
+        assert _wait(all_bound, timeout=45), \
+            "the scheduler binary never bound the job over HTTP"
+    finally:
+        sched.terminate()
+        try:
+            sched.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            sched.kill()

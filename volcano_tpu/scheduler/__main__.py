@@ -75,6 +75,19 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "out-of-process scheduler consumes this process "
                          "over RemoteStore watches (use with "
                          "--api-address)")
+    ap.add_argument("--server", default="",
+                    help="remote-scheduler mode: run ONLY the scheduler "
+                         "stack against an --api-server-only cluster "
+                         "process at host:port — informers over HTTP "
+                         "long-poll, binds/statuses written back through "
+                         "the gateway (the vc-scheduler-vs-API-server "
+                         "process split)")
+    ap.add_argument("--token", default="",
+                    help="bearer token for a --server gateway started "
+                         "with --api-token")
+    ap.add_argument("--insecure-skip-tls-verify", action="store_true",
+                    help="accept self-signed gateway certificates "
+                         "(https --server)")
     ap.add_argument("--run-for", type=float, default=0.0,
                     help="exit after N seconds (0 = until SIGINT)")
     ap.add_argument("--version", action="store_true")
@@ -121,6 +134,110 @@ def seed_cluster_state(store, path: str) -> None:
             job_cli.run_job(store, yaml.safe_dump(doc))
 
 
+def _wait_for_signal_or_deadline(args, stop_evt) -> None:
+    """Install SIGINT/SIGTERM -> stop_evt, wait (bounded by --run-for),
+    restore handlers — the run-loop scaffold shared by the in-process and
+    remote-scheduler modes."""
+
+    def on_signal(signum, frame):
+        stop_evt.set()
+
+    prev_handlers = {}
+    try:
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            prev_handlers[sig] = signal.signal(sig, on_signal)
+    except ValueError:
+        pass  # not the main thread (tests drive main() directly)
+
+    try:
+        stop_evt.wait(timeout=args.run_for or None)
+    finally:
+        stop_evt.set()
+        for sig, handler in prev_handlers.items():
+            signal.signal(sig, handler)
+
+
+def run_remote_scheduler(args) -> int:
+    """The scheduler as its own OS process against a remote API-server
+    process (one run with --api-server-only --api-address): informer
+    streams arrive over RemoteStore long-poll watches, effector writes
+    (binds, conditions, statuses) return through the gateway, and leader
+    election CASes the same remote ConfigMap lock — the reference's
+    vc-scheduler binary shape (cmd/scheduler/app/server.go)."""
+
+    from volcano_tpu.scheduler.cache import SchedulerCache
+    from volcano_tpu.scheduler.httpserver import ObservabilityServer
+    from volcano_tpu.scheduler.scheduler import Scheduler
+    from volcano_tpu.store.remote import RemoteStore
+
+    remote = RemoteStore(args.server, token=args.token or None,
+                         tls_verify=not args.insecure_skip_tls_verify)
+    if not remote.healthy():
+        logging.error("gateway at %s is not reachable/healthy", args.server)
+        return 1
+    if args.cluster_state:
+        # the seed corpus goes THROUGH the gateway (admission applies
+        # server-side), so a seeded remote run schedules rather than
+        # silently seeing an empty cluster
+        seed_cluster_state(remote, args.cluster_state)
+    cache = SchedulerCache(
+        store=remote, scheduler_name=args.scheduler_name,
+        default_queue=args.default_queue)
+    cache.run()
+    scheduler = Scheduler(
+        cache, scheduler_conf="", schedule_period=args.schedule_period)
+    if args.scheduler_conf:
+        scheduler.conf_path = args.scheduler_conf
+
+    stop_evt = threading.Event()
+    elector = None
+    metrics_srv = ObservabilityServer(args.listen_address).start()
+    healthz_srv = ObservabilityServer(
+        args.healthz_address,
+        healthy=lambda: not stop_evt.is_set()
+        and (elector is None or elector.healthy())
+        and remote.healthy(timeout=2.0)).start()
+    logging.info(
+        "remote scheduler against %s; metrics on :%d/metrics, healthz on "
+        ":%d/healthz", args.server, metrics_srv.port, healthz_srv.port)
+
+    if args.leader_elect:
+        import os
+        import socket
+
+        from volcano_tpu.scheduler.leaderelection import (
+            LeaderElector, ResourceLock)
+
+        identity = (args.leader_elect_identity
+                    or f"{socket.gethostname()}-{os.getpid()}")
+        # the lock ConfigMap lives in the REMOTE store: competing
+        # scheduler processes on different hosts CAS the same record
+        # through the gateway, exactly client-go against the API server
+        lock = ResourceLock(
+            remote, args.lock_object_namespace, args.scheduler_name,
+            identity)
+        elector = LeaderElector(
+            lock,
+            on_started_leading=scheduler.run,
+            on_stopped_leading=scheduler.stop)
+        elector.start()
+        logging.info("leader election enabled (identity=%s)", identity)
+    else:
+        scheduler.run()
+
+    _wait_for_signal_or_deadline(args, stop_evt)
+
+    if elector is not None:
+        elector.stop()
+    else:
+        scheduler.stop()
+    remote.flush_events()
+    remote.stop_watches()
+    metrics_srv.stop()
+    healthz_srv.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     if args.version:
@@ -142,6 +259,9 @@ def main(argv=None) -> int:
     o.percentage_of_nodes_to_find = args.percentage_of_nodes_to_find
     o.listen_address = args.listen_address
     o.healthz_address = args.healthz_address
+
+    if args.server:
+        return run_remote_scheduler(args)
 
     from volcano_tpu.cluster import Cluster
     from volcano_tpu.scheduler.httpserver import ObservabilityServer
@@ -204,22 +324,7 @@ def main(argv=None) -> int:
     else:
         cluster.run(scheduling=not args.api_server_only)
 
-    def on_signal(signum, frame):
-        stop_evt.set()
-
-    prev_handlers = {}
-    try:
-        for sig in (signal.SIGINT, signal.SIGTERM):
-            prev_handlers[sig] = signal.signal(sig, on_signal)
-    except ValueError:
-        pass  # not the main thread (tests drive main() directly)
-
-    try:
-        stop_evt.wait(timeout=args.run_for or None)
-    finally:
-        stop_evt.set()
-        for sig, handler in prev_handlers.items():
-            signal.signal(sig, handler)
+    _wait_for_signal_or_deadline(args, stop_evt)
 
     if elector is not None:
         elector.stop()

@@ -187,9 +187,12 @@ class RemoteStore:
         return [RemoteEvent(i["event_type"], i["reason"], i["message"])
                 for i in out.get("items", [])]
 
-    def healthy(self) -> bool:
+    def healthy(self, timeout: Optional[float] = None) -> bool:
+        """Gateway liveness. ``timeout`` overrides the store default —
+        health probes should fail fast, not inherit a 10s RPC budget."""
         try:
-            return bool(self._request("GET", "/healthz").get("ok"))
+            return bool(self._request("GET", "/healthz",
+                                      timeout=timeout).get("ok"))
         except Exception:
             return False
 

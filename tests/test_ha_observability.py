@@ -77,7 +77,13 @@ class TestLeaderElection:
         time.sleep(0.3)
         assert not eb.is_leader()  # lease held by a
         ea.stop()  # clean shutdown releases the lease
+        t0 = time.monotonic()
         assert _wait(eb.is_leader, timeout=2.0)
+        # a RELEASED lease must not cost the standby a full lease wait —
+        # the empty-holder fast path takes over within ~a retry period
+        # (this is what distinguishes release from crash takeover below)
+        assert time.monotonic() - t0 < FAST["lease_duration"], \
+            "clean release fell back to full lease expiry"
         eb.stop()
 
     def test_standby_takes_over_after_crash(self):

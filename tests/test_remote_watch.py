@@ -276,3 +276,46 @@ def test_remote_controller_observes_phase_flip(cluster_proc):
             "remote QueueController never aggregated the phase flip"
     finally:
         remote.stop_watches()
+
+
+def test_leader_election_over_remote_store(cluster_proc):
+    """HA across the wire: two electors on SEPARATE RemoteStore clients
+    CAS the same ConfigMap lock through a LIVE cluster process's gateway
+    (the reference's client-go election against the API server). Exactly
+    one leads; when it stops, the standby takes over."""
+    import threading
+
+    from volcano_tpu.scheduler.leaderelection import (
+        LeaderElector, ResourceLock)
+
+    _, port = cluster_proc
+    a = RemoteStore(f"127.0.0.1:{port}", token="watch-tok")
+    b = RemoteStore(f"127.0.0.1:{port}", token="watch-tok")
+    leads = {"a": threading.Event(), "b": threading.Event()}
+
+    def elector(name, store):
+        lock = ResourceLock(store, "volcano-system", "remote-ha", name)
+        return LeaderElector(
+            lock,
+            on_started_leading=leads[name].set,
+            on_stopped_leading=leads[name].clear,
+            lease_duration=2.0, renew_deadline=1.0, retry_period=0.3)
+
+    ea, eb = elector("a", a), elector("b", b)
+    try:
+        ea.start()
+        assert leads["a"].wait(10), "first elector never acquired over HTTP"
+        eb.start()
+        # the standby must NOT lead while the leader renews
+        assert not leads["b"].wait(2.5)
+        assert ea.is_leader() and not eb.is_leader()
+
+        # leader releases -> standby acquires through the same remote lock
+        ea.stop()
+        assert leads["b"].wait(10), "standby never took over after release"
+        assert eb.is_leader()
+    finally:
+        # an assertion mid-flight must not leave elector threads CASing a
+        # dead gateway for the rest of the pytest session
+        ea.stop()
+        eb.stop()

@@ -131,7 +131,34 @@ def seed_cluster_state(store, path: str) -> None:
             if store.try_get("Queue", "", q.metadata.name) is None:
                 store.create(q)
         elif kind == "Job":
+            name = meta.get("name", "")
+            ns = meta.get("namespace", "default")
+            if name and store.try_get("Job", ns, name) is not None:
+                continue  # re-seed (restart / HA standby): already present
             job_cli.run_job(store, yaml.safe_dump(doc))
+
+
+def _make_elector(args, store, run_workload, stop_workload):
+    """Leader-elect wiring shared by the in-process and remote modes:
+    identity derivation, the store-backed ConfigMap lock, and the elector
+    whose callbacks start/stop the mode's workload."""
+    import os
+    import socket
+
+    from volcano_tpu.scheduler.leaderelection import (
+        LeaderElector, ResourceLock)
+
+    identity = (args.leader_elect_identity
+                or f"{socket.gethostname()}-{os.getpid()}")
+    lock = ResourceLock(
+        store, args.lock_object_namespace, args.scheduler_name, identity)
+    elector = LeaderElector(
+        lock,
+        on_started_leading=run_workload,
+        on_stopped_leading=stop_workload)
+    elector.start()
+    logging.info("leader election enabled (identity=%s)", identity)
+    return elector
 
 
 def _wait_for_signal_or_deadline(args, stop_evt) -> None:
@@ -202,26 +229,10 @@ def run_remote_scheduler(args) -> int:
         ":%d/healthz", args.server, metrics_srv.port, healthz_srv.port)
 
     if args.leader_elect:
-        import os
-        import socket
-
-        from volcano_tpu.scheduler.leaderelection import (
-            LeaderElector, ResourceLock)
-
-        identity = (args.leader_elect_identity
-                    or f"{socket.gethostname()}-{os.getpid()}")
         # the lock ConfigMap lives in the REMOTE store: competing
         # scheduler processes on different hosts CAS the same record
         # through the gateway, exactly client-go against the API server
-        lock = ResourceLock(
-            remote, args.lock_object_namespace, args.scheduler_name,
-            identity)
-        elector = LeaderElector(
-            lock,
-            on_started_leading=scheduler.run,
-            on_stopped_leading=scheduler.stop)
-        elector.start()
-        logging.info("leader election enabled (identity=%s)", identity)
+        elector = _make_elector(args, remote, scheduler.run, scheduler.stop)
     else:
         scheduler.run()
 
@@ -303,24 +314,10 @@ def main(argv=None) -> int:
                      api_srv.port)
 
     if args.leader_elect:
-        import os
-        import socket
-
-        from volcano_tpu.scheduler.leaderelection import (
-            LeaderElector, ResourceLock)
-
-        identity = (args.leader_elect_identity
-                    or f"{socket.gethostname()}-{os.getpid()}")
-        lock = ResourceLock(
-            cluster.store, args.lock_object_namespace,
-            args.scheduler_name, identity)
-        elector = LeaderElector(
-            lock,
-            on_started_leading=lambda: cluster.run(
-                scheduling=not args.api_server_only),
-            on_stopped_leading=lambda: cluster.stop())
-        elector.start()
-        logging.info("leader election enabled (identity=%s)", identity)
+        elector = _make_elector(
+            args, cluster.store,
+            lambda: cluster.run(scheduling=not args.api_server_only),
+            cluster.stop)
     else:
         cluster.run(scheduling=not args.api_server_only)
 

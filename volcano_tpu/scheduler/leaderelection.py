@@ -142,6 +142,8 @@ class LeaderElector:
         self._stop = threading.Event()
         self._leading = False
         self._observed_holder = ""
+        self._observed_record_key = None
+        self._observed_time = 0.0
         self._thread: Optional[threading.Thread] = None
 
     # -- public ------------------------------------------------------------
@@ -251,6 +253,20 @@ class LeaderElector:
             if self.on_new_leader is not None:
                 self.on_new_leader(holder)
 
+    def _observe_record(self, record) -> None:
+        """Track WHEN this elector locally observed the record last change
+        (client-go leaderelection.go observedTime): lease expiry is judged
+        as 'unchanged for a full lease_duration on MY clock', never by
+        comparing the record's timestamps against the local clock — the
+        holder's clock (time.monotonic has a per-host epoch) and ours need
+        not be related when the lock lives in a remote store."""
+        key = (record.holder_identity, record.acquire_time,
+               record.renew_time)
+        if key != self._observed_record_key:
+            self._observed_record_key = key
+            self._observed_time = self._clock()
+        self._observe(record.holder_identity)
+
     def _try_acquire_or_renew(self) -> bool:
         now = self._clock()
         identity = self.lock.identity
@@ -262,7 +278,7 @@ class LeaderElector:
                 lease_duration=self.lease_duration,
                 acquire_time=now, renew_time=now)
             if self.lock.create(record):
-                self._observe(identity)
+                self._observe_record(record)
                 return True
             return False  # raced; retry next period
 
@@ -275,13 +291,18 @@ class LeaderElector:
                 lease_duration=self.lease_duration,
                 acquire_time=now, renew_time=now)
             if self.lock.update(new, version):
-                self._observe(identity)
+                self._observe_record(new)
                 return True
             return False
 
-        self._observe(record.holder_identity)
+        self._observe_record(record)
         if record.holder_identity != identity:
-            if now < record.renew_time + self.lease_duration:
+            # expiry by LOCAL observation age, not by the record's
+            # timestamps: cross-host monotonic clocks share no epoch
+            # (client-go leaderelection.go:281-290 does the same). An
+            # EMPTY holder is a clean release — no lease to wait out
+            if record.holder_identity and \
+                    now < self._observed_time + self.lease_duration:
                 return False  # current leader still within its lease
             # lease expired: try to take over (CAS rejects racing standbys)
             new = LeaderElectionRecord(
@@ -308,5 +329,10 @@ class LeaderElector:
         record, version = got
         if record is None or record.holder_identity != self.lock.identity:
             return
-        record.renew_time = 0.0  # expired immediately
+        # client-go's release: EMPTY the holder (observation-based expiry
+        # deliberately ignores timestamps, so a zeroed renew_time alone
+        # would read as just another record change and make the standby
+        # wait a full lease; an empty holder bypasses the lease wait)
+        record.holder_identity = ""
+        record.renew_time = 0.0
         self.lock.update(record, version)

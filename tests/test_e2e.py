@@ -299,3 +299,36 @@ class TestThreadedCluster:
                 raise AssertionError("pods never started running")
         finally:
             cluster.stop()
+
+
+class TestJobCascadeDeletion:
+    def test_deleting_job_reaps_children(self):
+        """Deleting a Job must cascade to its pods, PodGroup, and
+        plugin-controlled resources — the reference gets this from
+        Kubernetes OwnerReference GC (job_controller.go:418-448); here
+        the job controller owns the cascade. Regression: children used
+        to orphan forever, permanently occupying cluster capacity."""
+        cluster = make_cluster()
+        job = make_job(min_available=2, tasks=(("worker", 2),),
+                       plugins={"svc": [], "ssh": []})
+        job.spec.scheduler_name = "volcano"
+        cluster.store.create(job)
+        cluster.settle(4)
+        assert len(cluster.store.list("Pod", namespace="ns1")) == 2
+        assert cluster.store.try_get("PodGroup", "ns1", "job1") is not None
+        assert cluster.store.try_get("ConfigMap", "ns1", "job1-svc") is not None
+
+        cluster.store.delete("Job", "ns1", "job1")
+        cluster.settle(4)
+        assert cluster.store.list("Pod", namespace="ns1") == []
+        assert cluster.store.try_get("PodGroup", "ns1", "job1") is None
+        assert cluster.store.try_get("ConfigMap", "ns1", "job1-svc") is None
+        assert cluster.store.try_get("ConfigMap", "ns1", "job1-ssh") is None
+
+        # freed capacity is actually reusable: a new gang binds fully
+        job2 = make_job(name="job2", min_available=2, tasks=(("w", 2),))
+        job2.spec.scheduler_name = "volcano"
+        cluster.store.create(job2)
+        cluster.settle(4)
+        pods = cluster.store.list("Pod", namespace="ns1")
+        assert len(pods) == 2 and all(p.spec.node_name for p in pods)

@@ -41,6 +41,7 @@ class JobController:
         self._queues: List[deque] = [deque() for _ in range(self.workers)]
         self._command_queue: deque = deque()
         self._err_tasks: deque = deque()
+        self._cascades: deque = deque()  # (job, JobInfo|None) to reap
         # failed requests wait here (the rate-limited requeue analog,
         # job_controller.go:59-64): sync mode retries them on the NEXT
         # process_all pass; threaded mode after an exponential backoff
@@ -115,7 +116,60 @@ class JobController:
             event=JobEvent.OUT_OF_SYNC))
 
     def _delete_job(self, job: objects.Job) -> None:
+        # cascade deletion: the reference relies on Kubernetes
+        # OwnerReference garbage collection to reap a deleted Job's pods
+        # and PodGroup (job_controller.go:418-448 stamps the owner refs;
+        # the kube GC does the reaping). This substrate has no separate
+        # GC controller, so the cascade lives here. The handler itself
+        # stays within the watch contract (fast; only mirror + enqueue):
+        # it snapshots the job's children from the controller cache,
+        # drops the cache entry FIRST — so no worker can process a
+        # POD_EVICTED request against the dead job and resurrect the
+        # children via sync_job — and queues the reap for a worker.
+        try:
+            job_info = self.cache.get(job_key_by_name(
+                job.metadata.namespace, job.metadata.name))
+        except KeyError:
+            job_info = None
         self.cache.delete(job)
+        with self._cond:
+            self._cascades.append((job, job_info))
+            self._cond.notify_all()
+
+    def _process_cascade(self, item) -> None:
+        """Reap a deleted Job's children: pods (from the cache's per-job
+        index — no namespace scan), the PodGroup, and plugin-controlled
+        resources. Per-child error isolation: one failed delete must not
+        abandon the rest (a logged orphan beats a silent cascade stop)."""
+        job, job_info = item
+        ns, name = job.metadata.namespace, job.metadata.name
+        if job_info is not None:
+            pod_names = [p.metadata.name
+                         for pods in job_info.pods.values()
+                         for p in pods.values()]
+        else:
+            # no cache snapshot (e.g. deletion raced a fresh restart):
+            # fall back to the annotated-ownership scan
+            pod_names = [p.metadata.name
+                         for p in self.store.list("Pod", namespace=ns)
+                         if p.metadata.annotations.get(
+                             objects.JOB_NAME_KEY) == name]
+        for pn in pod_names:
+            try:
+                self.store.try_delete("Pod", ns, pn)
+            except Exception:  # noqa: BLE001
+                logger.exception("cascade: failed to delete pod %s/%s",
+                                 ns, pn)
+        try:
+            self.store.try_delete("PodGroup", ns, name)
+        except Exception:  # noqa: BLE001
+            logger.exception("cascade: failed to delete podgroup %s/%s",
+                             ns, name)
+        try:
+            self.actions.plugin_on_job_delete(job)
+        except Exception:  # noqa: BLE001
+            logger.exception("cascade: plugin cleanup failed for %s/%s",
+                             ns, name)
 
     def _pod_request(self, pod: objects.Pod) -> Optional[dict]:
         if not is_controlled_by(pod, objects.Job.KIND):
@@ -277,7 +331,9 @@ class JobController:
             item = None
             kind = None
             with self._cond:
-                if self._command_queue:
+                if self._cascades:
+                    item, kind = self._cascades.popleft(), "cascade"
+                elif self._command_queue:
                     item, kind = self._command_queue.popleft(), "command"
                 elif self._err_tasks:
                     item, kind = self._err_tasks.popleft(), "resync"
@@ -289,7 +345,9 @@ class JobController:
             if item is None:
                 return processed
             processed += 1
-            if kind == "command":
+            if kind == "cascade":
+                self._process_cascade(item)
+            elif kind == "command":
                 self._process_command(item)
             elif kind == "resync":
                 self._process_resync(item)
@@ -339,20 +397,26 @@ class JobController:
             kind = None
             self._flush_deferred(ignore_backoff=False)
             with self._cond:
-                while not self._command_queue and not self._err_tasks and not self._stop:
+                while not self._command_queue and not self._err_tasks \
+                        and not self._cascades and not self._stop:
                     self._cond.wait(0.2)
                     break  # periodically re-check deferred backoffs
                 if self._stop:
                     return
-                if not self._command_queue and not self._err_tasks:
+                if not self._command_queue and not self._err_tasks \
+                        and not self._cascades:
                     continue
-                if self._command_queue:
+                if self._cascades:
+                    item, kind = self._cascades.popleft(), "cascade"
+                elif self._command_queue:
                     item, kind = self._command_queue.popleft(), "command"
                 else:
                     item, kind = self._err_tasks.popleft(), "resync"
                 self._inflight += 1
             try:
-                if kind == "command":
+                if kind == "cascade":
+                    self._process_cascade(item)
+                elif kind == "command":
                     self._process_command(item)
                 else:
                     self._process_resync(item)
@@ -366,7 +430,7 @@ class JobController:
         def idle():
             return (not any(self._queues) and not self._command_queue
                     and not self._err_tasks and not self._deferred
-                    and self._inflight == 0)
+                    and not self._cascades and self._inflight == 0)
 
         with self._cond:
             return self._cond.wait_for(idle, timeout)

@@ -94,6 +94,11 @@ class DefaultEvictor:
 
 
 class DefaultStatusUpdater:
+    """Status writebacks tolerate deletion races: the snapshot a session
+    closes against can be a full cycle stale, and an object deleted in the
+    meantime makes its status update moot, not an error — the reference's
+    updater logs update failures and moves on (job_updater.go:44-52)."""
+
     def __init__(self, store: Store):
         self.store = store
 
@@ -104,12 +109,18 @@ class DefaultStatusUpdater:
                 break
         else:
             pod.status.conditions.append(condition)
-        self.store.update(pod)
+        try:
+            self.store.update(pod)
+        except NotFoundError:
+            pass  # pod deleted since the session snapshot
 
     def update_pod_group(self, pod_group: objects.PodGroup, status=None) -> None:
         if status is not None:
             pod_group.status = status
-        self.store.update_status(pod_group)
+        try:
+            self.store.update_status(pod_group)
+        except NotFoundError:
+            pass  # pod group deleted since the session snapshot
 
 
 class DefaultVolumeBinder:

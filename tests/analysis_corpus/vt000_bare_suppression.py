@@ -1,0 +1,6 @@
+"""VT000 corpus: a suppression with no justification is itself a finding —
+the gate cannot be quietly eroded."""
+
+
+def probe(x):
+    return x.value.item()  # vclint: disable=VT001

@@ -1,0 +1,35 @@
+"""VT001 negative corpus: host work outside jit regions, static casts
+inside them, and the suppression path. vclint must stay silent here."""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def solve(chunk, arrays):
+    # float() of a static python scalar (a bare name) is trace-time config,
+    # not a host sync of a traced value
+    big = jnp.asarray(float(chunk), arrays["req"].dtype)
+    return jnp.cumsum(arrays["req"]) + big
+
+
+def host_prepare(arrays):
+    # host-side encode path: numpy + wall clocks are fine outside jit
+    t0 = time.time()
+    pad = np.zeros_like(arrays["req"])
+    return pad, time.time() - t0
+
+
+def host_probe(x):
+    # .item() on the host fetch path, not reachable from any jit root
+    return x.item()
+
+
+@jax.jit
+def debug_solve(arrays):
+    probe = arrays["req"].item()  # vclint: disable=VT001 - debug-only kernel, gated off the warm path
+    return probe

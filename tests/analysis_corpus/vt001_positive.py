@@ -1,0 +1,32 @@
+"""VT001 positive corpus: host syncs / impure calls inside jit regions.
+
+Parsed by vclint only — never imported; names may be undefined at runtime.
+Markers: a "vclint-expect" comment sits on every line the rule must flag
+(the same convention holds across the corpus).
+"""
+
+import functools
+import time
+
+import jax
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def solve(spec, arrays):
+    total = arrays["req"].sum()
+    budget = float(arrays["budget"][0])  # vclint-expect: VT001
+    t0 = time.time()  # vclint-expect: VT001
+    host = np.cumsum(arrays["req"])  # vclint-expect: VT001
+    n = total.item()  # vclint-expect: VT001
+    return _reachable_helper(total, budget, host, n, t0)
+
+
+def _reachable_helper(total, budget, host, n, t0):
+    # not decorated, but referenced from the jit root above -> in-region
+    return total + budget + host + int(total[0]) + t0  # vclint-expect: VT001
+
+
+@jax.jit
+def solve_bare_decorator(arrays):
+    return arrays["req"].item()  # vclint-expect: VT001

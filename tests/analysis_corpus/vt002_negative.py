@@ -1,0 +1,39 @@
+"""VT002 negative corpus: bucketed extents, post-pad shape reads, host-only
+allocations, and the suppression path."""
+
+import numpy as np
+
+
+def _bucket(n):
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pad_axis(a, axis, size, fill=0):
+    return a
+
+
+def dispatch(enc, tasks, spec):
+    # the pad-to-bucket contract, followed
+    tb = _bucket(len(tasks))
+    arr = np.zeros((tb, 4))
+    arrays = pad_encoded(enc)
+    # shapes read back from padded buffers are bucket-stable
+    kb = int(arrays["cls_req"].shape[0])
+    spec2 = spec._replace(round_min_progress=max(2, kb // 128))
+    out = _pad_axis(arr, 0, tb)
+    return solve_rounds(spec2, {"a": out})
+
+
+def host_stats(enc, tasks):
+    # no kernel dispatch in this function: host accounting buffers may be
+    # sized by live counts freely
+    return np.zeros((len(tasks), 2))
+
+
+def mesh_pad(a, node_multiple):
+    n = a.shape[0]
+    nb = ((n + node_multiple - 1) // node_multiple) * node_multiple
+    return _pad_axis(a, 0, nb)  # vclint: disable=VT002 - mesh-multiple node pad; node count is deployment-stable

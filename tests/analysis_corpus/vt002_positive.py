@@ -1,0 +1,27 @@
+"""VT002 positive corpus: raw len()/.shape extents reaching jit-static
+sinks (pad sizes, SolveSpec fields, kernel-input allocations)."""
+
+import numpy as np
+
+
+def _bucket(n):
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pad_axis(a, axis, size, fill=0):
+    return a
+
+
+def dispatch(enc, tasks, spec):
+    t = len(tasks)
+    arr = np.zeros((t, 4))  # vclint-expect: VT002
+    padded = _pad_axis(arr, 0, enc["x"].shape[0])  # vclint-expect: VT002
+    spec2 = spec._replace(round_min_progress=t)  # vclint-expect: VT002
+    return solve_rounds(spec2, {"a": padded})  # vclint-expect: VT002
+
+
+def build_spec(tasks):
+    return SolveSpec(round_min_progress=len(tasks))  # vclint-expect: VT002

@@ -1,0 +1,40 @@
+"""VT003 negative corpus: the discipline followed — reads under the lock,
+writes after it, handlers that only mirror + enqueue, deferred closures,
+and the suppression path."""
+
+import threading
+
+
+class GoodCache:
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.Lock()
+        self._jobs = {}
+        self._queue = []
+        store.watch("Job", WatchHandler(added=self._on_job))
+
+    def _on_job(self, job):
+        # handler contract: mirror + enqueue only
+        self._queue.append(job)
+
+    def writeback(self, pod):
+        with self._lock:
+            pending = list(self._jobs)
+        # store write AFTER the lock is released — no ABBA window
+        self.store.update(pod)
+        return pending
+
+    def lookup(self, key):
+        with self._lock:
+            return self._jobs.get(key)
+
+    def deferred(self):
+        with self._lock:
+            def flush():
+                # closure body runs later, outside the locked region
+                self.store.update_status(self._jobs)
+            self._cb = flush
+
+    def legacy_sync(self):
+        with self._lock:
+            self.store.delete("Pod", "ns", "p")  # vclint: disable=VT003 - single-threaded bootstrap, store has no watchers yet

@@ -1,0 +1,29 @@
+"""VT003 positive corpus: re-entrant lock acquisition, store writes under a
+held lock, and watch handlers that write back into the store."""
+
+import threading
+
+
+class BadCache:
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.Lock()
+        self._jobs = {}
+        store.watch("Job", WatchHandler(added=self._on_job))
+
+    def refresh(self):
+        with self._lock:
+            self._rebuild()  # vclint-expect: VT003
+
+    def _rebuild(self):
+        with self._lock:
+            self._jobs.clear()
+
+    def writeback(self, pod):
+        with self._lock:
+            self.store.update(pod)  # vclint-expect: VT003
+
+    def _on_job(self, job):
+        # watch handlers run under the STORE lock: a synchronous write
+        # re-enters dispatch
+        self.store.update_status(job)  # vclint-expect: VT003

@@ -1,0 +1,39 @@
+"""VT004 negative corpus: the commit-or-discard gate followed, caller-owned
+statements, ownership escapes, and the suppression path."""
+
+
+def place(ssn, tasks, host):
+    stmt = ssn.statement()
+    ok = True
+    for t in tasks:
+        try:
+            stmt.allocate(t, host)
+        except KeyError:
+            ok = False
+            break
+    if ok and ssn.job_ready():
+        stmt.commit()
+    else:
+        stmt.discard()
+
+
+def helper_owns_nothing(stmt, task, host):
+    # caller-owned statement (a parameter): closing is the caller's job
+    stmt.pipeline(task, host)
+
+
+def build(ssn, task):
+    stmt = ssn.statement()
+    stmt.allocate(task, "n1")
+    return stmt  # escapes to the caller, which commits/discards
+
+
+def delegate(ssn, task, closer):
+    stmt = ssn.statement()
+    stmt.allocate(task, "n1")
+    closer(stmt)  # ownership handed to the closer callable
+
+
+def fire_and_forget(ssn, task, host):
+    stmt = ssn.statement()
+    stmt.pipeline(task, host)  # vclint: disable=VT004 - session-local pipeline, never committed by design

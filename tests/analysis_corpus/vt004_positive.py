@@ -1,0 +1,17 @@
+"""VT004 positive corpus: statements with tentative ops dropped on the
+floor — no commit()/discard() and no ownership transfer."""
+
+
+def place_no_close(ssn, tasks, host):
+    stmt = ssn.statement()
+    for t in tasks:
+        stmt.allocate(t, host)  # vclint-expect: VT004
+    return True
+
+
+def evict_no_close(ssn, victim):
+    st = ssn.statement()
+    st.evict(victim, "preempt")  # vclint-expect: VT004
+    if victim.ready():
+        return victim
+    return None

@@ -1,0 +1,28 @@
+"""VT005 negative corpus: sorted iteration, order-free set uses
+(membership, sizes), dict iteration (insertion-ordered), and the
+suppression path."""
+
+
+def encode(tasks, names):
+    uids = {t.uid for t in tasks}
+    rows = [lookup(u) for u in sorted(uids)]
+    seen = set()
+    out = []
+    for t in tasks:
+        if t.uid in seen:  # membership is order-free
+            continue
+        seen.add(t.uid)
+        out.append(t)
+    count = len(uids)  # size is order-free
+    by_name = {t.name: t for t in out}
+    for name in by_name:  # dicts iterate in insertion order — deterministic
+        count += 1
+    return rows, out, count
+
+
+def commutative_fold(names, weight):
+    scratch = {n for n in names}
+    total = 0.0
+    for n in scratch:  # vclint: disable=VT005 - feeds a commutative sum; order cannot change the result
+        total += weight(n)
+    return total

@@ -1,0 +1,20 @@
+"""VT005 positive corpus: unsorted set iteration feeding dense arrays."""
+
+import numpy as np
+
+
+def encode(tasks, names):
+    uids = {t.uid for t in tasks}
+    rows = [lookup(u) for u in uids]  # vclint-expect: VT005
+    for name in set(names):  # vclint-expect: VT005
+        rows.append(name)
+    order = list(uids)  # vclint-expect: VT005
+    return np.array(rows), order
+
+
+def merge(seen, extra):
+    combined = set(seen) | set(extra)
+    out = []
+    while combined:
+        out.append(combined.pop())  # vclint-expect: VT005
+    return out

@@ -18,6 +18,7 @@ from tests.test_tpu_parity import DEFAULT_TIERS, gang_cluster
 from volcano_tpu.api import objects
 from volcano_tpu.api.resource import Resource
 from volcano_tpu.scheduler.framework import close_session, get_action, open_session
+from volcano_tpu.utils.jaxcompile import CompileWatcher
 from volcano_tpu.scheduler.util.test_utils import (
     build_node,
     build_pod,
@@ -568,6 +569,32 @@ class TestRoundsResidue:
         binds = cache.binder.binds
         assert len(binds) == 2, binds
         assert binds["ns1/pgw-p0"] == "node-001", binds
+
+
+class TestWarmPath:
+    """Steady-state sessions must never retrace: shapes are bucket-padded
+    (ops/solver.py _bucket) so identical-bucket snapshots hit the jit cache.
+    CompileWatcher.assert_no_compiles makes a retrace fail HERE, not three
+    rounds later as a bench regression (bench tpu_warm_compiles)."""
+
+    def test_second_identical_session_does_not_compile(self):
+        populate = gang_cluster(n_groups=20, min_member=4, n_nodes=6)
+        run_rounds(populate)  # cold run: compiles allowed
+        watcher = CompileWatcher.install()
+        with watcher.assert_no_compiles("second identical-shape session"):
+            cache, prof = run_rounds(populate)
+        assert prof["rounds"] >= 1
+        check_invariants(cache, 4)
+
+    def test_same_bucket_churn_does_not_compile(self):
+        # 80 -> 76 tasks and 20 -> 19 jobs both land in the same buckets
+        # (128 / 32): count churn inside a bucket must reuse the program
+        run_rounds(gang_cluster(n_groups=20, min_member=4, n_nodes=6))
+        watcher = CompileWatcher.install()
+        with watcher.assert_no_compiles("same-bucket churned session"):
+            cache, _ = run_rounds(gang_cluster(n_groups=19, min_member=4,
+                                               n_nodes=6))
+        check_invariants(cache, 4)
 
 
 class TestPolicyShape:

@@ -1,0 +1,155 @@
+"""vclint — the static-analysis tier-1 gate.
+
+Two halves:
+1. the golden corpus (tests/analysis_corpus/): every rule fires on every
+   marked line of its positive fixture and stays silent on its negative
+   fixture (which includes the suppression-comment path);
+2. the repo gate: the full rule set over volcano_tpu/ yields ZERO
+   unsuppressed findings, via the same tools/lint.sh entry point any CI
+   uses — so the kernel-purity / bucket-shape / lock-discipline /
+   statement-hygiene / determinism contracts are machine-checked on every
+   PR, not rediscovered in bench regressions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from volcano_tpu.analysis import (
+    all_rules,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+    get_rule,
+)
+
+pytestmark = pytest.mark.lint
+
+REPO = Path(__file__).resolve().parent.parent
+CORPUS = Path(__file__).resolve().parent / "analysis_corpus"
+RULE_IDS = ("VT001", "VT002", "VT003", "VT004", "VT005")
+
+_EXPECT_RE = re.compile(r"#\s*vclint-expect:\s*(VT\d{3})")
+
+
+def expected_lines(path: Path, rule_id: str) -> set:
+    out = set()
+    for lineno, line in enumerate(path.read_text().splitlines(), start=1):
+        m = _EXPECT_RE.search(line)
+        if m and m.group(1) == rule_id:
+            out.add(lineno)
+    return out
+
+
+def rule_findings(path: Path, rule_id: str):
+    findings = analyze_file(str(path), rules=[get_rule(rule_id)],
+                            respect_filters=False)
+    return [f for f in findings if f.rule == rule_id]
+
+
+class TestCorpus:
+    @pytest.mark.parametrize("rule_id", RULE_IDS)
+    def test_rule_fires_on_positive_corpus(self, rule_id):
+        path = CORPUS / f"{rule_id.lower()}_positive.py"
+        expected = expected_lines(path, rule_id)
+        assert len(expected) >= 2, f"{path} needs >= 2 positive cases"
+        got = {f.line for f in rule_findings(path, rule_id) if not f.suppressed}
+        assert got == expected, (
+            f"{rule_id} on {path.name}: expected lines {sorted(expected)}, "
+            f"got {sorted(got)}")
+
+    @pytest.mark.parametrize("rule_id", RULE_IDS)
+    def test_rule_silent_on_negative_corpus(self, rule_id):
+        path = CORPUS / f"{rule_id.lower()}_negative.py"
+        active = [f for f in rule_findings(path, rule_id) if not f.suppressed]
+        assert active == [], (
+            f"{rule_id} false positives on {path.name}: "
+            f"{[f.format() for f in active]}")
+
+    @pytest.mark.parametrize("rule_id", RULE_IDS)
+    def test_negative_corpus_exercises_suppression(self, rule_id):
+        """Each negative fixture must carry a real-but-suppressed violation,
+        proving the disable comment is what silences the rule."""
+        path = CORPUS / f"{rule_id.lower()}_negative.py"
+        suppressed = [f for f in rule_findings(path, rule_id) if f.suppressed]
+        assert suppressed, f"{path.name} has no suppressed finding"
+
+    def test_bare_suppression_is_a_finding(self):
+        path = CORPUS / "vt000_bare_suppression.py"
+        findings = analyze_file(str(path), respect_filters=False)
+        vt000 = [f for f in findings if f.rule == "VT000" and not f.suppressed]
+        assert len(vt000) == 1, [f.format() for f in findings]
+        src = path.read_text().splitlines()
+        assert "vclint: disable=VT001" in src[vt000[0].line - 1]
+
+    def test_justified_suppression_is_not_a_finding(self):
+        findings = analyze_source(
+            "x = 1  # vclint: disable=VT005 - feeds an order-free sum\n",
+            "inline.py", respect_filters=False)
+        assert not [f for f in findings if f.rule == "VT000"]
+
+
+class TestFramework:
+    def test_every_rule_registered_with_scope(self):
+        rules = {r.id: r for r in all_rules()}
+        for rid in RULE_IDS:
+            assert rid in rules
+            assert rules[rid].patterns, f"{rid} has no default path scope"
+
+    def test_path_scoping(self):
+        vt1 = get_rule("VT001")
+        assert vt1.applies_to("volcano_tpu/ops/kernels.py")
+        assert vt1.applies_to(str(REPO / "volcano_tpu/ops/rounds.py"))
+        assert not vt1.applies_to("volcano_tpu/controllers/queue.py")
+        vt3 = get_rule("VT003")
+        assert vt3.applies_to("volcano_tpu/controllers/job/controller.py")
+        assert vt3.applies_to("volcano_tpu/scheduler/cache/cache.py")
+        assert not vt3.applies_to("volcano_tpu/ops/solver.py")
+
+    def test_syntax_error_reported_not_raised(self):
+        findings = analyze_source("def broken(:\n", "broken.py",
+                                  respect_filters=False)
+        assert findings and findings[0].rule == "VT999"
+
+    def test_cli_json_and_exit_codes(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        pos = subprocess.run(
+            [sys.executable, "-m", "volcano_tpu.analysis", "--json",
+             "--no-default-filter",
+             str(CORPUS / "vt004_positive.py")],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert pos.returncode == 1, pos.stderr
+        payload = json.loads(pos.stdout)
+        assert any(f["rule"] == "VT004" for f in payload)
+        neg = subprocess.run(
+            [sys.executable, "-m", "volcano_tpu.analysis", "--json",
+             "--no-default-filter",
+             str(CORPUS / "vt004_negative.py")],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert neg.returncode == 0, neg.stdout + neg.stderr
+        assert json.loads(neg.stdout) == []
+
+
+class TestRepoGate:
+    """The analyzer is part of tier-1 forever: the package must scan clean."""
+
+    def test_repo_has_zero_unsuppressed_findings(self):
+        findings = analyze_paths([str(REPO / "volcano_tpu")])
+        active = [f.format() for f in findings if not f.suppressed]
+        assert active == [], "\n".join(active)
+
+    def test_lint_sh_gate_passes(self):
+        """The shared entry point (analyzer + compileall) — the exact
+        command CI runs — must exit 0."""
+        env = dict(os.environ, PYTHON=sys.executable, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            ["bash", str(REPO / "tools" / "lint.sh")],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# The lint gate — the ONE definition shared by tests/test_static_analysis.py
+# and any CI wrapper, so "what the gate checks" can never fork:
+#   1. vclint (python -m volcano_tpu.analysis): the VT001-VT005 invariant
+#      rules over the whole package, zero unsuppressed findings required
+#      (rationale per rule: docs/static-analysis.md);
+#   2. compileall: every module byte-compiles (import-free syntax gate).
+#
+# Usage: tools/lint.sh   (from anywhere; PYTHON overrides the interpreter)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python3}"
+"$PY" -m volcano_tpu.analysis volcano_tpu
+"$PY" -m compileall -q volcano_tpu

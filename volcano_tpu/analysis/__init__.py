@@ -1,0 +1,26 @@
+"""vclint — AST-based invariant checker for this repo's machine-checked
+contracts (kernel purity, bucket shapes, lock discipline, statement
+hygiene, hot-path determinism).
+
+Usage:
+    python -m volcano_tpu.analysis volcano_tpu/
+    python -m volcano_tpu.analysis --json --select VT003 volcano_tpu/controllers/
+
+Rules live in volcano_tpu/analysis/rules.py; the framework (registry,
+suppressions, output) in core.py; rationale per rule in
+docs/static-analysis.md. tests/test_static_analysis.py wires the whole rule
+set into the tier-1 gate via tools/lint.sh.
+"""
+
+from volcano_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Rule,
+    all_rules,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+    get_rule,
+    register_rule,
+    render,
+)
+from volcano_tpu.analysis import rules  # noqa: F401  (populates the registry)

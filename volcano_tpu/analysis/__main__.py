@@ -1,0 +1,62 @@
+"""CLI for vclint: ``python -m volcano_tpu.analysis [paths...]``.
+
+Exit codes: 0 = clean (no unsuppressed findings), 1 = findings, 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from volcano_tpu.analysis import all_rules, analyze_paths, get_rule, render
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m volcano_tpu.analysis",
+        description="vclint — AST invariant checker for volcano-tpu "
+                    "(rules: docs/static-analysis.md)")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: the "
+                             "volcano_tpu package)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable JSON findings")
+    parser.add_argument("--select", default=None, metavar="VT001,VT003",
+                        help="run only these rule ids")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="include suppressed findings in the report")
+    parser.add_argument("--no-default-filter", action="store_true",
+                        help="run every rule on every file, ignoring the "
+                             "per-rule path scopes (corpus/test mode)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="list registered rules and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            scopes = ", ".join(rule.patterns) or "(meta)"
+            print(f"{rule.id}  {rule.title}  [{scopes}]")
+        return 0
+
+    rules = None
+    if args.select:
+        try:
+            rules = [get_rule(r.strip()) for r in args.select.split(",")]
+        except KeyError as e:
+            print(f"unknown rule: {e}", file=sys.stderr)
+            return 2
+
+    paths = args.paths
+    if not paths:
+        paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+    findings = analyze_paths(paths, rules,
+                             respect_filters=not args.no_default_filter)
+    print(render(findings, as_json=args.as_json,
+                 show_suppressed=args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

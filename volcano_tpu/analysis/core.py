@@ -1,0 +1,219 @@
+"""vclint core — AST visitor framework, rule registry, suppressions, output.
+
+The repo's latency and correctness story rests on invariants no unit test
+can see from the outside: kernel code must never host-sync mid-trace, every
+dynamic extent must pass through the pad-to-bucket contract before it can
+reach a jit static argument, watch handlers must stay fast and lock-clean,
+statements must always close. vclint checks those contracts lexically, on
+every tier-1 run, so a violation fails the PR that introduces it instead of
+surfacing as a multi-second warm-path stall in a bench three rounds later.
+
+Suppression contract: a finding is silenced by a ``# vclint: disable=VT00X``
+comment on the finding line or the line directly above; a
+``# vclint: disable-file=VT00X`` comment anywhere silences the rule for the
+whole file. Every suppression MUST carry a justification after the rule
+list (``# vclint: disable=VT002 - node axis pads to the mesh multiple``);
+a bare suppression is itself a finding (VT000), so the gate cannot be
+quietly eroded.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence
+
+_SUPPRESS_RE = re.compile(
+    r"vclint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)\s*(.*)",
+)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "suppressed": self.suppressed,
+        }
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: tuple
+    file_level: bool
+    justification: str
+
+
+def parse_suppressions(src: str) -> List[Suppression]:
+    """Extract vclint suppression comments via the tokenizer (comments only,
+    so a 'vclint:' inside a string literal can never disable a rule)."""
+    out: List[Suppression] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m is None:
+                continue
+            rules = tuple(r.strip() for r in m.group(2).split(","))
+            just = m.group(3).strip().lstrip("-—–:. ").strip()
+            out.append(Suppression(
+                line=tok.start[0], rules=rules,
+                file_level=m.group(1) == "disable-file",
+                justification=just))
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+class Rule:
+    """A vclint rule: an id, the default path scope, and an AST check.
+
+    ``patterns`` are fnmatch globs applied to '/' + the posix path, so
+    ``*/ops/*.py`` matches both absolute and repo-relative spellings.
+    """
+
+    id: str = "VT000"
+    title: str = ""
+    patterns: Sequence[str] = ()
+
+    def applies_to(self, path: str) -> bool:
+        posix = "/" + path.replace(os.sep, "/").lstrip("/")
+        return any(fnmatch.fnmatch(posix, pat) for pat in self.patterns)
+
+    def check(self, tree: ast.AST, src: str, path: str) -> List[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register_rule(cls):
+    """Class decorator: instantiate and add to the global registry."""
+    inst = cls()
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    return [_REGISTRY[rid] for rid in sorted(_REGISTRY)]
+
+
+def get_rule(rule_id: str) -> Rule:
+    return _REGISTRY[rule_id]
+
+
+def analyze_source(
+    src: str,
+    path: str,
+    rules: Optional[Sequence[Rule]] = None,
+    respect_filters: bool = True,
+) -> List[Finding]:
+    """Run ``rules`` over one source blob. Returns ALL findings with
+    ``suppressed`` marked; callers filter on it. A syntax error is reported
+    as a VT999 finding rather than an exception so one broken file cannot
+    mask the rest of a tree scan."""
+    if rules is None:
+        rules = all_rules()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("VT999", path, e.lineno or 0, e.offset or 0,
+                        f"syntax error: {e.msg}")]
+
+    findings: List[Finding] = []
+    for rule in rules:
+        if respect_filters and not rule.applies_to(path):
+            continue
+        findings.extend(rule.check(tree, src, path))
+
+    sups = parse_suppressions(src)
+    # VT000 meta-rule: a suppression without a justification is a finding.
+    for s in sups:
+        if not s.justification:
+            findings.append(Finding(
+                "VT000", path, s.line, 0,
+                "suppression without justification — write "
+                "'# vclint: disable=%s - <why this is safe>'"
+                % ",".join(s.rules)))
+
+    file_disabled = set()
+    line_disabled: Dict[int, set] = {}
+    for s in sups:
+        if s.file_level:
+            file_disabled.update(s.rules)
+        else:
+            line_disabled.setdefault(s.line, set()).update(s.rules)
+    for f in findings:
+        if f.rule in file_disabled \
+                or f.rule in line_disabled.get(f.line, ()) \
+                or f.rule in line_disabled.get(f.line - 1, ()):
+            f.suppressed = True
+    return findings
+
+
+def analyze_file(path: str, rules: Optional[Sequence[Rule]] = None,
+                 respect_filters: bool = True) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    return analyze_source(src, path, rules, respect_filters)
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(root, name))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def analyze_paths(paths: Iterable[str],
+                  rules: Optional[Sequence[Rule]] = None,
+                  respect_filters: bool = True) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        findings.extend(analyze_file(path, rules, respect_filters))
+    return findings
+
+
+def render(findings: Sequence[Finding], as_json: bool = False,
+           show_suppressed: bool = False) -> str:
+    shown = [f for f in findings if show_suppressed or not f.suppressed]
+    if as_json:
+        return json.dumps([f.to_dict() for f in shown], indent=2)
+    lines = [f.format() for f in shown]
+    active = sum(1 for f in findings if not f.suppressed)
+    muted = sum(1 for f in findings if f.suppressed)
+    lines.append(
+        f"vclint: {active} finding(s), {muted} suppressed"
+        if (active or muted) else "vclint: clean")
+    return "\n".join(lines)

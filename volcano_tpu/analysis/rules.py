@@ -1,0 +1,787 @@
+"""vclint rules VT001–VT005 — the repo's real failure modes, made lexical.
+
+Each rule mirrors a contract the reference Volcano enforces structurally
+(goroutines, informers, compiled Go) and this rebuild enforces by
+convention; docs/static-analysis.md carries the full rationale and the
+before/after examples per rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set
+
+from volcano_tpu.analysis.core import Finding, Rule, register_rule
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _func_defs(tree: ast.AST) -> List[ast.FunctionDef]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+# ---------------------------------------------------------------------------
+# VT001 — kernel purity
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class KernelPurity(Rule):
+    """Host syncs / impure host calls inside jit regions.
+
+    A ``.item()``, a ``float()``/``int()`` cast of a traced value, a host
+    numpy call, or a wall-clock read inside a jit-compiled function either
+    blocks on the device mid-trace or silently bakes a host value into the
+    compiled program — both break the 'session solve is one pre-compiled
+    XLA program' contract (ops/kernels.py module docstring; the reference's
+    hot loop is pre-compiled Go with no such seam)."""
+
+    id = "VT001"
+    title = "host sync / impurity inside a jit region"
+    patterns = ("*/ops/*.py",)
+
+    _TIME_CALLS = {
+        "time.time", "time.perf_counter", "time.monotonic",
+        "time.process_time", "datetime.now", "datetime.datetime.now",
+    }
+
+    @staticmethod
+    def _is_jit_decorator(dec: ast.AST) -> bool:
+        if isinstance(dec, ast.Call):
+            f = dotted(dec.func)
+            if f in ("functools.partial", "partial") and dec.args:
+                return dotted(dec.args[0]) in ("jax.jit", "jit")
+            return f in ("jax.jit", "jit")
+        return dotted(dec) in ("jax.jit", "jit")
+
+    @staticmethod
+    def _numpy_aliases(tree: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "numpy":
+                        out.add(alias.asname or "numpy")
+        return out
+
+    def check(self, tree, src, path):
+        by_name: Dict[str, ast.FunctionDef] = {}
+        top_level: List[ast.FunctionDef] = []
+        for fn in _func_defs(tree):
+            by_name.setdefault(fn.name, fn)
+            top_level.append(fn)
+
+        roots = [fn for fn in top_level
+                 if any(self._is_jit_decorator(d) for d in fn.decorator_list)]
+        # reachability: any function whose NAME appears inside a reachable
+        # function's subtree is conservatively part of the jit region
+        # (covers direct calls, lax.cond/while_loop branch functions, and
+        # `fn.__wrapped__` re-entry). Nested defs are covered by subtree
+        # scans of their parents.
+        reachable: List[ast.FunctionDef] = []
+        seen: Set[str] = set()
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            if fn.name in seen:
+                continue
+            seen.add(fn.name)
+            reachable.append(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and node.id in by_name \
+                        and node.id not in seen:
+                    frontier.append(by_name[node.id])
+
+        np_aliases = self._numpy_aliases(tree)
+        findings: List[Finding] = []
+        visited: Set[int] = set()
+        for fn in reachable:
+            for node in ast.walk(fn):
+                if id(node) in visited or not isinstance(node, ast.Call):
+                    continue
+                visited.add(id(node))
+                func = node.func
+                if isinstance(func, ast.Attribute) and func.attr == "item" \
+                        and not node.args:
+                    findings.append(Finding(
+                        self.id, path, node.lineno, node.col_offset,
+                        ".item() forces a device->host sync inside the jit "
+                        f"region rooted at a @jax.jit function ('{fn.name}')"))
+                    continue
+                name = dotted(func)
+                if isinstance(func, ast.Name) \
+                        and func.id in ("float", "int", "bool") and node.args \
+                        and isinstance(node.args[0],
+                                       (ast.Call, ast.Subscript, ast.Attribute)):
+                    findings.append(Finding(
+                        self.id, path, node.lineno, node.col_offset,
+                        f"{func.id}() of a traced expression host-syncs (or "
+                        f"bakes a stale host value) inside jit region "
+                        f"'{fn.name}'"))
+                elif name is not None and name.split(".")[0] in np_aliases:
+                    findings.append(Finding(
+                        self.id, path, node.lineno, node.col_offset,
+                        f"host numpy call {name}() inside jit region "
+                        f"'{fn.name}' — use jax.numpy so the op stays in the "
+                        f"compiled program"))
+                elif name in self._TIME_CALLS:
+                    findings.append(Finding(
+                        self.id, path, node.lineno, node.col_offset,
+                        f"wall-clock read {name}() inside jit region "
+                        f"'{fn.name}' is traced once and frozen into the "
+                        f"compiled program"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# VT002 — bucket-shape discipline
+# ---------------------------------------------------------------------------
+
+_NONE, _BLESSED, _TAINT = 0, 1, 2
+
+
+@register_rule
+class BucketShape(Rule):
+    """Unbucketed dynamic extents flowing into shape-defining sinks.
+
+    Any ``len(...)``/``.shape`` value that reaches a pad size, a SolveSpec
+    (jit-static) field, or a kernel-input allocation without passing
+    through ``_bucket()`` re-keys the XLA program every time the live count
+    churns — the steady-state retrace that turns a ~100 ms cycle into a
+    multi-second stall (ops/solver.py pad-to-bucket contract,
+    BENCH tpu_warm_compiles=[0,0,0,0,0]). Shapes read back from
+    ``pad_encoded`` results are bucket-stable and stay clean."""
+
+    id = "VT002"
+    title = "unbucketed dynamic shape reaches a jit-static sink"
+    patterns = ("*/ops/solver.py",)
+
+    SANITIZERS = {"_bucket"}
+    BLESSED_CALLS = {"pad_encoded"}
+    PAD_FUNCS = {"_pad_axis"}
+    SPEC_CTORS = {"SolveSpec"}
+    KERNEL_ENTRIES = {"solve_allocate", "solve_rounds", "solve_rounds_packed"}
+    ALLOC_FUNCS = {"zeros", "ones", "empty", "full"}
+
+    @staticmethod
+    def _numpy_aliases(tree: ast.AST) -> Set[str]:
+        return KernelPurity._numpy_aliases(tree)
+
+    def check(self, tree, src, path):
+        findings: List[Finding] = []
+        np_aliases = self._numpy_aliases(tree)
+        for fn in _func_defs(tree):
+            dispatches = any(
+                isinstance(n, ast.Call) and (dotted(n.func) or "").split(".")[-1]
+                in self.KERNEL_ENTRIES
+                for n in ast.walk(fn))
+            self._run_function(fn, dispatches, np_aliases, path, findings)
+        return findings
+
+    # -- tiny forward taint walk (statement order, last write wins) --------
+
+    def _run_function(self, fn, dispatches, np_aliases, path, findings):
+        env: Dict[str, int] = {}
+        for stmt in fn.body:
+            self._stmt(stmt, env, dispatches, np_aliases, path, findings)
+
+    def _stmt(self, stmt, env, dispatches, np_aliases, path, findings):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs get their own pass from check()
+        if isinstance(stmt, ast.Assign):
+            st = self._expr(stmt.value, env, dispatches, np_aliases, path, findings)
+            for tgt in stmt.targets:
+                self._bind(tgt, stmt.value, st, env, dispatches, np_aliases,
+                           path, findings)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            st = self._expr(stmt.value, env, dispatches, np_aliases, path, findings)
+            self._bind(stmt.target, stmt.value, st, env, dispatches,
+                       np_aliases, path, findings)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            st = self._expr(stmt.value, env, dispatches, np_aliases, path, findings)
+            if isinstance(stmt.target, ast.Name):
+                env[stmt.target.id] = max(env.get(stmt.target.id, _NONE), st)
+            return
+        if isinstance(stmt, ast.For):
+            st = self._expr(stmt.iter, env, dispatches, np_aliases, path, findings)
+            if isinstance(stmt.target, ast.Name):
+                env[stmt.target.id] = st
+            elif isinstance(stmt.target, ast.Tuple):
+                for el in stmt.target.elts:
+                    if isinstance(el, ast.Name):
+                        env[el.id] = st
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s, env, dispatches, np_aliases, path, findings)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test, env, dispatches, np_aliases, path, findings)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s, env, dispatches, np_aliases, path, findings)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._expr(item.context_expr, env, dispatches, np_aliases,
+                           path, findings)
+            for s in stmt.body:
+                self._stmt(s, env, dispatches, np_aliases, path, findings)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body + stmt.orelse + stmt.finalbody:
+                self._stmt(s, env, dispatches, np_aliases, path, findings)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s, env, dispatches, np_aliases, path, findings)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, env, dispatches, np_aliases, path, findings)
+
+    def _bind(self, tgt, value, st, env, dispatches, np_aliases, path, findings):
+        if isinstance(tgt, ast.Name):
+            env[tgt.id] = st
+        elif isinstance(tgt, ast.Tuple):
+            if isinstance(value, ast.Tuple) and len(value.elts) == len(tgt.elts):
+                for el, v in zip(tgt.elts, value.elts):
+                    if isinstance(el, ast.Name):
+                        env[el.id] = self._expr(
+                            v, env, dispatches, np_aliases, path, [])
+            else:
+                for el in tgt.elts:
+                    if isinstance(el, ast.Name):
+                        env[el.id] = st
+
+    def _expr(self, node, env, dispatches, np_aliases, path, findings) -> int:
+        if isinstance(node, ast.Name):
+            return env.get(node.id, _NONE)
+        if isinstance(node, ast.Constant):
+            return _NONE
+        if isinstance(node, ast.Call):
+            return self._call(node, env, dispatches, np_aliases, path, findings)
+        if isinstance(node, ast.Attribute):
+            base = self._expr(node.value, env, dispatches, np_aliases, path,
+                              findings)
+            if node.attr == "shape":
+                return _BLESSED if base == _BLESSED else _TAINT
+            return base
+        if isinstance(node, ast.Subscript):
+            st = self._expr(node.value, env, dispatches, np_aliases, path,
+                            findings)
+            self._expr(node.slice, env, dispatches, np_aliases, path, findings)
+            return st
+        if isinstance(node, ast.Lambda):
+            return _NONE
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            st = _NONE
+            for gen in node.generators:
+                st = max(st, self._expr(gen.iter, env, dispatches, np_aliases,
+                                        path, findings))
+            return st
+        st = _NONE
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                st = max(st, self._expr(child, env, dispatches, np_aliases,
+                                        path, findings))
+        return st
+
+    def _call(self, node, env, dispatches, np_aliases, path, findings) -> int:
+        name = dotted(node.func)
+        last = name.split(".")[-1] if name else ""
+        arg_states = [self._expr(a, env, dispatches, np_aliases, path, findings)
+                      for a in node.args]
+        kw_states = {kw.arg: self._expr(kw.value, env, dispatches, np_aliases,
+                                        path, findings)
+                     for kw in node.keywords}
+        recv_state = _NONE
+        if isinstance(node.func, ast.Attribute):
+            recv_state = self._expr(node.func.value, env, dispatches,
+                                    np_aliases, path, findings)
+
+        # sinks ------------------------------------------------------------
+        if last in self.PAD_FUNCS:
+            size_state = arg_states[2] if len(arg_states) > 2 \
+                else kw_states.get("size", _NONE)
+            if size_state == _TAINT:
+                findings.append(Finding(
+                    self.id, path, node.lineno, node.col_offset,
+                    f"raw len()/.shape-derived size reaches {last}() without "
+                    f"passing through _bucket() — every count churn retraces "
+                    f"the kernel"))
+        if last in self.SPEC_CTORS or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_replace"):
+            for kw in node.keywords:
+                if kw.arg and kw_states.get(kw.arg) == _TAINT:
+                    findings.append(Finding(
+                        self.id, path, node.lineno, node.col_offset,
+                        f"dynamic (len/.shape-derived) value in jit-static "
+                        f"SolveSpec field '{kw.arg}' — key it to the PADDED "
+                        f"bucket instead"))
+        if last in self.KERNEL_ENTRIES and arg_states \
+                and arg_states[0] == _TAINT:
+            findings.append(Finding(
+                self.id, path, node.lineno, node.col_offset,
+                f"tainted jit-static argument flows into {last}()"))
+        if dispatches and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in self.ALLOC_FUNCS \
+                and (name or "").split(".")[0] in np_aliases \
+                and arg_states and arg_states[0] == _TAINT:
+            findings.append(Finding(
+                self.id, path, node.lineno, node.col_offset,
+                f"kernel-input allocation {name}() sized by raw len()/.shape "
+                f"in a kernel-dispatching function — pad to _bucket() first"))
+
+        # resulting state ---------------------------------------------------
+        if last in self.SANITIZERS or last in self.BLESSED_CALLS:
+            return _BLESSED
+        if last == "len":
+            return _TAINT
+        states = arg_states + list(kw_states.values()) + [recv_state]
+        if _TAINT in states:
+            return _TAINT
+        if _BLESSED in states:
+            return _BLESSED
+        return _NONE
+
+
+# ---------------------------------------------------------------------------
+# VT003 — lock discipline
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class LockDiscipline(Rule):
+    """Re-entrant lock acquisition and store writes under a held lock.
+
+    The store delivers watch callbacks synchronously under ITS lock
+    (store/store.py docstring); controller/cache handlers acquire their own
+    locks inside those callbacks. Writing to the store while holding a
+    cache/controller lock therefore closes the classic ABBA cycle
+    (cache-lock -> store-lock here, store-lock -> cache-lock in dispatch),
+    and calling a self-lock-acquiring method under the same lock only works
+    while the lock stays reentrant. Watch handlers themselves must only
+    mirror + enqueue (cache.go:123-135 informer discipline)."""
+
+    id = "VT003"
+    title = "lock-discipline violation"
+    patterns = ("*/controllers/*.py", "*/scheduler/cache/*.py")
+
+    _LOCK_ATTR = re.compile(r"(^|_)(lock|mu|mutex|cond)$")
+    STORE_MUTATORS = {
+        "create", "update", "update_status", "delete", "try_delete",
+        "record_event", "record_events", "record_events_raw",
+        "record_scheduled", "watch",
+    }
+
+    def _lock_attr(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in ("self", "cls") \
+                and self._LOCK_ATTR.search(node.attr):
+            return node.attr
+        return None
+
+    def _is_store_mutator(self, call: ast.Call) -> bool:
+        func = call.func
+        if not isinstance(func, ast.Attribute) \
+                or func.attr not in self.STORE_MUTATORS:
+            return False
+        recv = dotted(func.value)
+        return recv is not None and (recv == "store" or recv.endswith(".store"))
+
+    @staticmethod
+    def _walk_excluding_defs(root_body):
+        """Yield nodes lexically executed in this body (deferred closures —
+        nested defs and lambdas — run later, outside the lock)."""
+        stack = list(root_body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _handler_names(self, cls: ast.ClassDef) -> Set[str]:
+        """Methods registered as watch callbacks via WatchHandler(...)."""
+        out: Set[str] = set()
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Call)
+                    and dotted(node.func) is not None
+                    and dotted(node.func).split(".")[-1] == "WatchHandler"):
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Attribute) \
+                        and isinstance(arg.value, ast.Name) \
+                        and arg.value.id == "self":
+                    out.add(arg.attr)
+                elif isinstance(arg, ast.Lambda):
+                    for sub in ast.walk(arg.body):
+                        if isinstance(sub, ast.Attribute) \
+                                and isinstance(sub.value, ast.Name) \
+                                and sub.value.id == "self":
+                            out.add(sub.attr)
+        return out
+
+    def check(self, tree, src, path):
+        findings: List[Finding] = []
+        for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+            methods = {m.name: m for m in cls.body
+                       if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+            lock_acquired: Dict[str, Set[str]] = {}
+            for name, m in methods.items():
+                attrs = set()
+                for node in ast.walk(m):
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            a = self._lock_attr(item.context_expr)
+                            if a:
+                                attrs.add(a)
+                lock_acquired[name] = attrs
+
+            for name, m in methods.items():
+                for node in ast.walk(m):
+                    if not isinstance(node, ast.With):
+                        continue
+                    held = [self._lock_attr(i.context_expr)
+                            for i in node.items]
+                    held = [h for h in held if h]
+                    if not held:
+                        continue
+                    for sub in self._walk_excluding_defs(node.body):
+                        if not isinstance(sub, ast.Call):
+                            continue
+                        func = sub.func
+                        if isinstance(func, ast.Attribute) \
+                                and isinstance(func.value, ast.Name) \
+                                and func.value.id == "self" \
+                                and func.attr in methods:
+                            shared = set(held) & lock_acquired[func.attr]
+                            if shared:
+                                a = sorted(shared)[0]
+                                findings.append(Finding(
+                                    self.id, path, sub.lineno, sub.col_offset,
+                                    f"self.{func.attr}() re-acquires "
+                                    f"self.{a} while it is already held in "
+                                    f"{cls.name}.{name} — hoist the call out "
+                                    f"of the locked region"))
+                        elif self._is_store_mutator(sub):
+                            findings.append(Finding(
+                                self.id, path, sub.lineno, sub.col_offset,
+                                f"store write {dotted(sub.func)}() under "
+                                f"self.{held[0]} in {cls.name}.{name} — store "
+                                f"mutations dispatch synchronous watch "
+                                f"callbacks (lock-order inversion); move the "
+                                f"write after the lock is released"))
+
+            for hname in self._handler_names(cls) & set(methods):
+                for node in ast.walk(methods[hname]):
+                    if isinstance(node, ast.Call) \
+                            and self._is_store_mutator(node):
+                        findings.append(Finding(
+                            self.id, path, node.lineno, node.col_offset,
+                            f"watch handler {cls.name}.{hname} writes to the "
+                            f"store — handlers run under the store lock and "
+                            f"must only mirror state + enqueue work"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# VT004 — statement hygiene
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class StatementHygiene(Rule):
+    """Statements with tentative ops but no commit()/discard().
+
+    A Statement logs allocate/pipeline/evict mutations against the SESSION
+    eagerly; only commit() flushes them to the cache effectors and only
+    discard() rolls them back (framework/statement.py; statement.go:309-340).
+    Dropping one on the floor leaves half-placed gangs in the session tree —
+    the exact bug class gang atomicity exists to prevent. A statement that
+    escapes the function (returned / stored / passed on) transfers closing
+    responsibility and is not flagged."""
+
+    id = "VT004"
+    title = "statement never committed or discarded"
+    patterns = ("*/scheduler/actions/*.py", "*/ops/solver.py")
+
+    TENTATIVE = {"allocate", "pipeline", "evict"}
+    CLOSING = {"commit", "discard"}
+
+    def check(self, tree, src, path):
+        findings: List[Finding] = []
+        for fn in _func_defs(tree):
+            owned: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and isinstance(node.value.func, ast.Attribute) \
+                        and node.value.func.attr == "statement":
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            owned.add(tgt.id)
+            if not owned:
+                continue
+            first_tentative: Dict[str, ast.Call] = {}
+            closed: Set[str] = set()
+            escaped: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in owned:
+                    nm = node.func.value.id
+                    if node.func.attr in self.TENTATIVE:
+                        first_tentative.setdefault(nm, node)
+                    elif node.func.attr in self.CLOSING:
+                        closed.add(nm)
+                # escapes: returned, stored on an object, or passed as a
+                # bare argument to another callable
+                if isinstance(node, ast.Return) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id in owned:
+                    escaped.add(node.value.id)
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id in owned \
+                        and any(not isinstance(t, ast.Name)
+                                for t in node.targets):
+                    escaped.add(node.value.id)
+                if isinstance(node, ast.Call):
+                    callee = dotted(node.func) or ""
+                    if callee.split(".")[0] not in owned:
+                        for arg in node.args:
+                            if isinstance(arg, ast.Name) and arg.id in owned:
+                                escaped.add(arg.id)
+            for nm, call in first_tentative.items():
+                if nm in closed or nm in escaped:
+                    continue
+                findings.append(Finding(
+                    self.id, path, call.lineno, call.col_offset,
+                    f"statement '{nm}' performs tentative "
+                    f"{call.func.attr}() with no reachable commit()/"
+                    f"discard() in '{fn.name}' — a dropped statement breaks "
+                    f"gang atomicity (statement.go:309-340)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# VT005 — hot-path determinism
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class HotPathDeterminism(Rule):
+    """Unsorted set iteration on paths that feed encoder arrays.
+
+    Python set order varies across processes (string hash randomization):
+    iterating one while building dense arrays, decode maps, or writeback
+    batches makes two replicas of the same snapshot disagree — fatal for
+    the replay benchmarks and for HA followers checking the leader's
+    placements. Wrap the iteration in sorted(...); membership tests,
+    len()/any()/min()/max() reductions stay free."""
+
+    id = "VT005"
+    title = "unsorted set iteration on a hot path"
+    patterns = ("*/ops/encoder.py", "*/ops/solver.py",
+                "*/scheduler/cache/*.py", "*/controllers/*.py")
+
+    _SET_CTORS = {"set", "frozenset"}
+    _SET_METHODS = {"union", "intersection", "difference",
+                    "symmetric_difference", "copy"}
+    _ITER_CALLS = {"list", "tuple", "iter", "enumerate", "reversed"}
+
+    def _dict_of_set_attrs(self, cls: ast.ClassDef) -> Set[str]:
+        """self attrs annotated Dict[?, Set[?]] — their .get()/[] values
+        are sets."""
+        out: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Attribute) \
+                    and isinstance(node.target.value, ast.Name) \
+                    and node.target.value.id == "self":
+                ann = ast.dump(node.annotation)
+                if re.search(r"id='(Dict|dict)'", ann) \
+                        and re.search(r"id='(Set|set|frozenset|FrozenSet)'",
+                                      ann):
+                    out.add(node.target.attr)
+        return out
+
+    def check(self, tree, src, path):
+        findings: List[Finding] = []
+        class_attrs: Dict[int, Set[str]] = {}
+        set_attrs: Dict[int, Set[str]] = {}
+        owner_of: Dict[int, int] = {}
+        for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+            class_attrs[id(cls)] = self._dict_of_set_attrs(cls)
+            attrs: Set[str] = set()
+            for node in ast.walk(cls):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    tgts = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    val = node.value
+                    for t in tgts:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self" \
+                                and val is not None \
+                                and self._set_valued(val, set(), set(), set()):
+                            attrs.add(t.attr)
+            set_attrs[id(cls)] = attrs
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    owner_of[id(fn)] = id(cls)
+
+        scopes: List = [(tree, None)]
+        for fn in _func_defs(tree):
+            scopes.append((fn, owner_of.get(id(fn))))
+        for scope, cls_id in scopes:
+            dict_attrs = class_attrs.get(cls_id, set()) if cls_id else set()
+            attr_sets = set_attrs.get(cls_id, set()) if cls_id else set()
+            self._scan_scope(scope, dict_attrs, attr_sets, path, findings)
+        return findings
+
+    def _set_valued(self, node, set_vars: Set[str], dict_attrs: Set[str],
+                    attr_sets: Set[str]) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in set_vars
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr in attr_sets
+        if isinstance(node, ast.BinOp) \
+                and isinstance(node.op, (ast.BitOr, ast.BitAnd,
+                                         ast.Sub, ast.BitXor)):
+            return self._set_valued(node.left, set_vars, dict_attrs, attr_sets) \
+                or self._set_valued(node.right, set_vars, dict_attrs, attr_sets)
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in self._SET_CTORS:
+                return True
+            if isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                if node.func.attr in self._SET_METHODS \
+                        and self._set_valued(recv, set_vars, dict_attrs,
+                                             attr_sets):
+                    return True
+                # dict-of-sets: self.X.get(...) / self.X.setdefault(...)
+                if node.func.attr in ("get", "setdefault") \
+                        and isinstance(recv, ast.Attribute) \
+                        and isinstance(recv.value, ast.Name) \
+                        and recv.value.id == "self" \
+                        and recv.attr in dict_attrs:
+                    return True
+            return False
+        if isinstance(node, ast.Subscript):
+            v = node.value
+            if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+                    and v.value.id == "self" and v.attr in dict_attrs:
+                return True
+        return False
+
+    def _scan_scope(self, scope, dict_attrs, attr_sets, path, findings):
+        set_vars: Set[str] = set()
+        body = scope.body if hasattr(scope, "body") else []
+        self._scan_stmts(body, set_vars, dict_attrs, attr_sets, path, findings)
+
+    def _scan_stmts(self, stmts, set_vars, dict_attrs, attr_sets, path,
+                    findings):
+        """Statement-order walk: check each statement's own expressions,
+        record set bindings, then recurse into nested blocks — so a set
+        assigned inside an ``if`` is known when its loop follows it."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope
+            if isinstance(stmt, ast.For):
+                if self._set_valued(stmt.iter, set_vars, dict_attrs, attr_sets):
+                    self._flag(stmt, "for loop", path, findings)
+                self._check_expr(stmt.iter, set_vars, dict_attrs, attr_sets,
+                                 path, findings)
+                self._scan_stmts(stmt.body + stmt.orelse, set_vars,
+                                 dict_attrs, attr_sets, path, findings)
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                val = stmt.value
+                if val is not None:
+                    self._check_expr(val, set_vars, dict_attrs, attr_sets,
+                                     path, findings)
+                    is_set = self._set_valued(val, set_vars, dict_attrs,
+                                              attr_sets)
+                    tgts = stmt.targets if isinstance(stmt, ast.Assign) \
+                        else [stmt.target]
+                    for t in tgts:
+                        if isinstance(t, ast.Name):
+                            if is_set:
+                                set_vars.add(t.id)
+                            else:
+                                set_vars.discard(t.id)
+                continue
+            sub_stmts: List[ast.stmt] = []
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._check_expr(child, set_vars, dict_attrs, attr_sets,
+                                     path, findings)
+                elif isinstance(child, ast.stmt):
+                    sub_stmts.append(child)
+                elif isinstance(child, ast.withitem):
+                    self._check_expr(child.context_expr, set_vars, dict_attrs,
+                                     attr_sets, path, findings)
+                elif isinstance(child, ast.ExceptHandler):
+                    sub_stmts.extend(
+                        c for c in ast.iter_child_nodes(child)
+                        if isinstance(c, ast.stmt))
+            if sub_stmts:
+                self._scan_stmts(sub_stmts, set_vars, dict_attrs, attr_sets,
+                                 path, findings)
+
+    def _flag(self, node, what, path, findings):
+        findings.append(Finding(
+            self.id, path, node.lineno, node.col_offset,
+            f"{what} iterates an unordered set — set order varies across "
+            f"processes (hash randomization); wrap it in sorted(...) so "
+            f"every replica encodes the same arrays"))
+
+    def _check_expr(self, expr, set_vars, dict_attrs, attr_sets, path,
+                    findings):
+        sv = lambda n: self._set_valued(n, set_vars, dict_attrs, attr_sets)  # noqa: E731
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+                for gen in node.generators:
+                    if sv(gen.iter):
+                        self._flag(node, "comprehension", path, findings)
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in self._ITER_CALLS \
+                        and node.args and sv(node.args[0]):
+                    self._flag(node, f"{node.func.id}()", path, findings)
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in ("map", "filter") \
+                        and len(node.args) > 1 and sv(node.args[1]):
+                    self._flag(node, f"{node.func.id}()", path, findings)
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "pop" and not node.args \
+                        and sv(node.func.value):
+                    self._flag(node, "set.pop()", path, findings)
+            elif isinstance(node, ast.Starred) and sv(node.value):
+                self._flag(node, "* unpacking", path, findings)

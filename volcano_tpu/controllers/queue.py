@@ -76,7 +76,10 @@ class QueueController:
         if queue is None:
             return
         with self._lock:
-            keys = list(self._pod_groups.get(name, ()))
+            # sorted: the reverse index is a set; status counts are order-
+            # free but the store reads below must replay identically on
+            # every replica
+            keys = sorted(self._pod_groups.get(name, ()))
 
         status = objects.QueueStatus(state=queue.status.state)
         for key in keys:

@@ -236,7 +236,9 @@ def _promote_exclusive(all_tasks, cand_idx, bulk_universe_idx, nodes,
     # group's selector matches)
     pair_map: dict = {}
     universe = set(bulk_universe_idx) | set(keys) | set(port_keys)
-    for ti in universe:
+    # sorted: pair_map candidate lists must not inherit set order, or two
+    # replicas of the same snapshot could walk closure checks differently
+    for ti in sorted(universe):
         pod = all_tasks[ti].pod
         if pod is None:
             continue

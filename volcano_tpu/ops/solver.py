@@ -96,9 +96,14 @@ def pad_encoded(enc: EncodedSnapshot, node_multiple: int = 1) -> Dict[str, np.nd
     a["job_active0"] = _pad_axis(a["job_active0"], 0, jb, fill=False)
     a["job_tie_rank"] = _pad_axis(a["job_tie_rank"], 0, jb, fill=np.iinfo(np.int32).max - 1)
     if node_multiple > 1 and n % node_multiple:
+        # the node axis deliberately pads to the MESH multiple, not a
+        # power-of-two bucket: node count is deployment-stable (churn lives
+        # in tasks/jobs), and bucket-padding it would change the sampling-
+        # window arithmetic over real nodes.
         nb = ((n + node_multiple - 1) // node_multiple) * node_multiple
         for name, axis in _NODE_AXIS.items():
-            a[name] = _pad_axis(a[name], axis, nb, fill=False if name in ("sig_mask", "node_real") else 0)
+            fill = False if name in ("sig_mask", "node_real") else 0
+            a[name] = _pad_axis(a[name], axis, nb, fill=fill)  # vclint: disable=VT002 - mesh-multiple node pad (see comment above)
     return a
 
 

@@ -163,7 +163,7 @@ def capture_node_axis(nodes_by_name: Dict[str, object]) -> Optional[NodeAxis]:
         cols = scalars[attr] = {}
         if scalar_name_set:
             ress = attr_objs[attr]
-            for rn in scalar_name_set:
+            for rn in sorted(scalar_name_set):
                 cols[rn] = np.array(
                     [(r.scalar_resources or {}).get(rn, 0.0) for r in ress],
                     np.float64)

@@ -14,6 +14,7 @@ callbacks fire on whichever thread compiles).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from dataclasses import dataclass
 
@@ -69,6 +70,25 @@ class CompileWatcher:
 
     def window(self) -> "_Window":
         return _Window(self)
+
+    @contextlib.contextmanager
+    def assert_no_compiles(self, what: str = "warm path"):
+        """Fail loudly if any XLA backend compile lands inside the block.
+
+        The enforcement twin of bench.py's per-session compile deltas
+        (``tpu_warm_compiles``): wrap a steady-state session in this and a
+        retrace fails the TEST that introduced it, instead of surfacing as
+        a multi-second stall in the next bench round. Yields the window so
+        callers can also inspect trace counts."""
+        win = self.window()
+        yield win
+        d = win.delta()
+        if d.compiles:
+            raise AssertionError(
+                f"{what}: {d.compiles} XLA compile(s) ({d.compile_s:.3f}s, "
+                f"{d.traces} retrace(s)) inside a no-compile window — the "
+                f"session solve must stay ONE pre-compiled program "
+                f"(docs/static-analysis.md; BENCH tpu_warm_compiles)")
 
 
 class _Window:

@@ -120,6 +120,20 @@ class TestGatewayWatch:
         names = {q.metadata.name for q in seen}
         assert names == {f"q{i}" for i in range(6)}
 
+    def test_event_flusher_respawns_after_stop_timeout(self):
+        # a stop_events() whose join timed out leaves _event_stop set and
+        # a flusher that exits after one drain; later events must still
+        # reach the gateway (a dead thread reference must not latch the
+        # recorder off forever)
+        q = self.store.create(_queue("evq"))
+        self.remote._event_stop = True  # simulate the timed-out stop
+        self.remote.record_event(q, "Normal", "First", "m1")
+        self.remote.flush_events()
+        self.remote.record_event(q, "Normal", "Second", "m2")
+        self.remote.flush_events()
+        reasons = {e.reason for e in self.store.events_for(q)}
+        assert {"First", "Second"} <= reasons
+
     def test_malformed_selector_is_400(self):
         with pytest.raises(ValueError):
             self.remote._request("GET", "/apis/Queue",
@@ -137,6 +151,69 @@ class TestGatewayWatch:
         with pytest.raises(ValueError):
             self.remote._request("GET", "/watch/Queue",
                                  query={"since": "nan-o-second"})
+
+
+class TestWatchResetSynthesis:
+    """The poller's reset handling, against a scripted transport (the
+    live-gateway race — an in-flight long-poll draining the burst before
+    the cursor falls behind — makes the ring-overflow path untestable
+    deterministically end-to-end).
+
+    Protocol script: the client syncs q0..q2, then every poll at its
+    cursor returns `reset`. The first re-list attempts FAIL (the cursor
+    must not advance past the gap), then a successful list returns only
+    q0+q5 — the poller must synthesize DELETED for q1/q2 (removed while
+    it was behind the journal ring; ADVICE r5 remote.py:344), re-ADD the
+    listed set, and resume from the reset's `next` cursor."""
+
+    def test_reset_diffs_known_set_and_retries_failed_relist(self):
+        from volcano_tpu.api import codec
+
+        remote = RemoteStore("127.0.0.1:1")  # transport is stubbed below
+        calls = {"list": 0, "polls": []}
+        stopper = threading.Event()
+
+        def fake_request(method, path, payload=None, query=None,
+                         timeout=None):
+            if path == "/apis/Queue":
+                calls["list"] += 1
+                if calls["list"] <= 2:
+                    raise RemoteStoreError("re-list unavailable")
+                return {"items": [codec.envelope(_queue("q0")),
+                                  codec.envelope(_queue("q5"))]}
+            assert path == "/watch/Queue"
+            since = int(query["since"])
+            calls["polls"].append(since)
+            if since == 0:
+                return {"events": [
+                    {"type": "ADDED", "object": codec.envelope(_queue(n))}
+                    for n in ("q0", "q1", "q2")], "next": 3}
+            if since == 3:
+                return {"reset": True, "next": 9}
+            # post-reset steady state: park until the test ends
+            stopper.wait(0.2)
+            return {"events": [], "next": since}
+
+        remote._request = fake_request
+        adds, dels = [], []
+        remote.watch("Queue", WatchHandler(
+            added=lambda o: adds.append(o.metadata.name),
+            deleted=lambda o: dels.append(o.metadata.name)))
+        try:
+            assert _wait(lambda: set(dels) == {"q1", "q2"}, timeout=30.0), \
+                (adds, dels, calls)
+            assert calls["list"] >= 3  # two failures retried, not skipped
+            # survivors + new objects re-ADDed after the deletes
+            assert adds[:3] == ["q0", "q1", "q2"]
+            assert set(adds[3:]) == {"q0", "q5"}
+            assert "q0" not in dels and "q5" not in dels
+            # the cursor resumed from the reset's `next`, and never
+            # advanced while the re-list was still failing
+            assert _wait(lambda: 9 in calls["polls"])
+            assert [s for s in calls["polls"] if s == 3][:3] == [3, 3, 3]
+        finally:
+            stopper.set()
+            remote.stop_watches()
 
 
 class TestGatewayAuth:

@@ -1,0 +1,467 @@
+"""Delta-maintained snapshot (scheduler/cache/snapkeeper.py).
+
+Covers the incremental open/close tentpole:
+- keeper mechanics: reuse of clean clones, re-clone on watch deltas and on
+  session-side mutations (pipelined placements MUST revert), per-session
+  scratch cleared on reuse, queue/PC changes forcing a full rebuild;
+- randomized churn parity: the incremental snapshot and a wholesale
+  rebuild produce identical session state and identical bindings, step
+  after step, under a random stream of watch deltas interleaved with
+  scheduling sessions;
+- consecutive rounds sessions on ONE cache: the bulk mirror flush leaves
+  the snapshot in sync, so steady-state opens reuse everything and warm
+  sessions stay retrace-free (CompileWatcher.assert_no_compiles);
+- the flush's per-flipped-task node accounting: a placement whose cache
+  twin was deleted in the defer window contributes nothing to cache node
+  idle/used (ADVICE r5, cache.py:748), native and Python paths both.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.scheduler.cache.snapkeeper import SnapshotKeeper
+from volcano_tpu.scheduler.framework import close_session, get_action, open_session
+from tests.helpers import (  # noqa: F401 (registers actions)
+    make_cache,
+    make_tiers,
+)
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list,
+    build_resource_list_with_pods,
+)
+
+ROUNDS_ARGS = {"tpuscore": {"tpuscore.mode": "rounds"}}
+DEFAULT_TIERS = (["priority", "gang"],
+                 ["drf", "predicates", "proportion", "nodeorder"])
+
+
+def _res_tuple(r):
+    return (r.milli_cpu, r.memory,
+            tuple(sorted((r.scalar_resources or {}).items())))
+
+
+def _digest(snap):
+    """Content digest of a snapshot, independent of object identity."""
+    jobs = {}
+    for uid, j in snap.jobs.items():
+        jobs[uid] = (
+            j.queue, j.priority, j.min_available,
+            _res_tuple(j.allocated), _res_tuple(j.total_request),
+            _res_tuple(j.pending_sum),
+            tuple(sorted((t.uid, int(t.status), t.node_name,
+                          _res_tuple(t.resreq))
+                         for t in j.tasks.values())),
+            tuple(sorted((int(s), tuple(sorted(b)))
+                         for s, b in j.task_status_index.items())),
+        )
+    nodes = {}
+    for name, nd in snap.nodes.items():
+        nodes[name] = (
+            _res_tuple(nd.idle), _res_tuple(nd.used),
+            _res_tuple(nd.releasing), nd.ready(),
+            tuple(sorted((k, int(t.status), _res_tuple(t.resreq))
+                         for k, t in nd.tasks.items())),
+        )
+    return jobs, nodes, tuple(sorted(snap.queues))
+
+
+def _axis_digest(axis):
+    if axis is None:
+        return None
+    import numpy as np
+
+    return (tuple(axis.names), axis.flags.tolist(),
+            {a: (axis.cpu[a].tolist(), axis.mem[a].tolist(),
+                 {rn: c.tolist() for rn, c in axis.scalars[a].items()})
+             for a in ("idle", "used", "alloc")},
+            axis.node_cnt.tolist(), axis.max_tasks.tolist(),
+            bool(np.all(axis.gens >= 0)))
+
+
+def _oracle_digest(cache):
+    """Wholesale rebuild of the same cache — the parity oracle."""
+    snap = SnapshotKeeper().snapshot(cache)
+    return _digest(snap), _axis_digest(snap.node_axis)
+
+
+def _populate_small(c, groups=6, nodes=5):
+    c.add_queue(build_queue("default"))
+    for g in range(groups):
+        pg = f"pg-{g:03d}"
+        c.add_pod_group(build_pod_group(pg, namespace="ns", min_member=2))
+        for i in range(4):
+            c.add_pod(build_pod(
+                "ns", f"{pg}-t{i}", "", objects.POD_PHASE_PENDING,
+                build_resource_list("500m", "512Mi"), pg))
+    for n in range(nodes):
+        c.add_node(build_node(
+            f"node-{n:03d}", build_resource_list_with_pods("8", "16Gi",
+                                                           pods=64)))
+
+
+class TestKeeperBasics:
+    def test_second_snapshot_reuses_clean_objects(self):
+        c = make_cache()
+        _populate_small(c)
+        s1 = c.snapshot()
+        s2 = c.snapshot()
+        ks = c.snap_keeper
+        assert ks.stats["rebuilds"] == 1 and ks.stats["incremental"] == 1
+        assert ks.stats["cloned_jobs"] == 0 and ks.stats["cloned_nodes"] == 0
+        for uid in s1.jobs:
+            assert s2.jobs[uid] is s1.jobs[uid]
+        for name in s1.nodes:
+            assert s2.nodes[name] is s1.nodes[name]
+        # the dicts themselves are fresh: consumers may delete entries
+        assert s2.jobs is not s1.jobs
+
+    def test_watch_delta_reclones_only_touched(self):
+        c = make_cache()
+        _populate_small(c)
+        s1 = c.snapshot()
+        c.add_pod(build_pod("ns", "pg-000-extra", "",
+                            objects.POD_PHASE_PENDING,
+                            build_resource_list("250m", "256Mi"), "pg-000"))
+        s2 = c.snapshot()
+        assert s2.jobs["ns/pg-000"] is not s1.jobs["ns/pg-000"]
+        assert len(s2.jobs["ns/pg-000"].tasks) == 5
+        assert s2.jobs["ns/pg-001"] is s1.jobs["ns/pg-001"]
+        assert _digest(s2) == _oracle_digest(c)[0]
+
+    def test_session_mutation_reverts_to_cache_truth(self):
+        # session-only placements (this is what a pipeline/un-dispatched
+        # allocate leaves behind) must NOT survive into the next session:
+        # the version gap between the handed-out clone and the keeper's
+        # record forces a re-clone back to the cache's PENDING truth
+        c = make_cache()
+        _populate_small(c)
+        s1 = c.snapshot()
+        job = s1.jobs["ns/pg-002"]
+        task = next(iter(job.tasks.values()))
+        job.update_task_status(task, TaskStatus.ALLOCATED)
+        task.node_name = "node-000"
+        s1.nodes["node-000"].add_task(task)
+        s2 = c.snapshot()
+        j2 = s2.jobs["ns/pg-002"]
+        assert j2 is not job
+        assert all(t.status == TaskStatus.PENDING for t in j2.tasks.values())
+        n2 = s2.nodes["node-000"]
+        assert not n2.tasks and n2.used.milli_cpu == 0
+        assert _digest(s2) == _oracle_digest(c)[0]
+
+    def test_fit_errors_cleared_on_reuse(self):
+        c = make_cache()
+        _populate_small(c)
+        s1 = c.snapshot()
+        job = s1.jobs["ns/pg-003"]
+        job.job_fit_errors = "0/5 nodes available"
+        job.nodes_fit_errors["some-task"] = object()
+        s2 = c.snapshot()
+        j2 = s2.jobs["ns/pg-003"]
+        assert j2 is job  # reused (fit errors don't move the version) ...
+        assert j2.job_fit_errors == "" and not j2.nodes_fit_errors
+
+    def test_queue_and_priority_class_changes_rebuild(self):
+        c = make_cache()
+        _populate_small(c)
+        c.snapshot()
+        c.add_queue(build_queue("burst"))
+        c.snapshot()
+        assert c.snap_keeper.stats["rebuilds"] == 2
+        c.add_priority_class(objects.PriorityClass(
+            metadata=objects.ObjectMeta(name="high"), value=100))
+        s3 = c.snapshot()
+        assert c.snap_keeper.stats["rebuilds"] == 3
+        assert _digest(s3) == _oracle_digest(c)[0]
+
+    def test_node_readiness_flip_updates_membership_and_axis(self):
+        c = make_cache()
+        _populate_small(c)
+        s1 = c.snapshot()
+        assert "node-004" in s1.nodes
+        bad = build_node("node-004",
+                         build_resource_list_with_pods("8", "16Gi", pods=64))
+        bad.status.conditions = [
+            objects.NodeCondition(type="Ready", status="False")]
+        c.add_node(bad)
+        s2 = c.snapshot()
+        assert "node-004" not in s2.nodes
+        assert list(s2.node_axis.names) == sorted(s2.nodes)
+        d, ax = _oracle_digest(c)
+        assert _digest(s2) == d and _axis_digest(s2.node_axis) == ax
+
+
+def _encode_state(cache):
+    """Open a tpuscore session and encode it; returns comparable state."""
+    import numpy as np
+
+    from volcano_tpu.ops.encoder import encode_session
+
+    ssn = open_session(cache, make_tiers(
+        ["tpuscore"], *DEFAULT_TIERS, arguments=ROUNDS_ARGS))
+    try:
+        enc = encode_session(ssn, allow_residue=True)
+        arrays = {k: np.asarray(v).copy() for k, v in enc.arrays.items()}
+        meta = (list(enc.node_names), list(enc.resource_names),
+                list(enc.queue_uids), list(enc.ns_names),
+                [t.uid for t in enc.task_infos],
+                [j.uid for j in enc.job_infos],
+                enc.residue_count, enc.has_releasing)
+    finally:
+        close_session(ssn)
+    return arrays, meta
+
+
+def _assert_encodes_equal(cache_a, cache_b, ctx=""):
+    import numpy as np
+
+    (arrs_a, meta_a) = _encode_state(cache_a)
+    (arrs_b, meta_b) = _encode_state(cache_b)
+    assert meta_a == meta_b, ctx
+    assert set(arrs_a) == set(arrs_b), ctx
+    for k in arrs_a:
+        assert np.array_equal(arrs_a[k], arrs_b[k]), f"{ctx}: array {k!r}"
+
+
+class TestChurnParity:
+    """Randomized watch deltas + sessions: incremental vs wholesale."""
+
+    N_STEPS = 24
+
+    def _apply_random_delta(self, rng, caches, state):
+        op = rng.choice(["add_pod", "add_pod", "del_pod", "rebind_pod",
+                         "add_group", "upd_node", "add_node", "del_node"])
+        if op == "add_pod" and state["groups"]:
+            pg = rng.choice(state["groups"])
+            name = f"{pg}-x{state['seq']}"
+            cpu = f"{rng.choice([250, 500])}m"  # drawn ONCE per delta so
+            for c in caches:                    # both caches stay twins
+                c.add_pod(build_pod(
+                    "ns", name, "", objects.POD_PHASE_PENDING,
+                    build_resource_list(cpu, "256Mi"), pg))
+            state["pods"].append(("ns", name, pg))
+        elif op == "del_pod" and state["pods"]:
+            ns, name, pg = state["pods"].pop(
+                rng.randrange(len(state["pods"])))
+            for c in caches:
+                job = c.jobs.get(f"{ns}/{pg}")
+                task = None
+                if job is not None:
+                    task = next((t for t in job.tasks.values()
+                                 if t.name == name), None)
+                if task is not None and task.pod is not None:
+                    c.delete_pod(task.pod)
+        elif op == "rebind_pod" and state["pods"]:
+            ns, name, pg = rng.choice(state["pods"])
+            node = rng.choice(state["nodes"]) if state["nodes"] else None
+            if node is None:
+                return
+            for c in caches:
+                job = c.jobs.get(f"{ns}/{pg}")
+                task = None
+                if job is not None:
+                    task = next((t for t in job.tasks.values()
+                                 if t.name == name), None)
+                if task is not None and task.pod is not None:
+                    old = task.pod
+                    new = build_pod(ns, name, node,
+                                    objects.POD_PHASE_RUNNING,
+                                    build_resource_list("250m", "256Mi"), pg)
+                    new.metadata.uid = old.metadata.uid
+                    new.metadata.creation_timestamp = \
+                        old.metadata.creation_timestamp
+                    c.update_pod_from_watch(old, new)
+        elif op == "add_group":
+            pg = f"pg-n{state['seq']}"
+            for c in caches:
+                c.add_pod_group(build_pod_group(pg, namespace="ns",
+                                                min_member=1))
+            state["groups"].append(pg)
+        elif op == "upd_node" and state["nodes"]:
+            name = rng.choice(state["nodes"])
+            cpu = rng.choice(["8", "12"])
+            for c in caches:
+                c.add_node(build_node(
+                    name, build_resource_list_with_pods(cpu, "16Gi",
+                                                        pods=64)))
+        elif op == "add_node":
+            name = f"node-n{state['seq']}"
+            for c in caches:
+                c.add_node(build_node(
+                    name, build_resource_list_with_pods("8", "16Gi",
+                                                        pods=64)))
+            state["nodes"].append(name)
+        elif op == "del_node" and len(state["nodes"]) > 2:
+            name = state["nodes"].pop(rng.randrange(len(state["nodes"])))
+            for c in caches:
+                c.delete_node(build_node(
+                    name, build_resource_list_with_pods("8", "16Gi",
+                                                        pods=64)))
+        state["seq"] += 1
+
+    def test_incremental_matches_wholesale_under_churn(self):
+        rng = random.Random(17)
+        a, b = make_cache(), make_cache()
+        b.snap_keeper.enabled = False  # wholesale rebuild every snapshot
+        for c in (a, b):
+            _populate_small(c)
+        state = {"groups": [f"pg-{g:03d}" for g in range(6)],
+                 "nodes": [f"node-{n:03d}" for n in range(5)],
+                 "pods": [("ns", f"pg-{g:03d}-t{i}", f"pg-{g:03d}")
+                          for g in range(6) for i in range(4)],
+                 "seq": 0}
+        tiers = (["priority", "gang"], ["drf", "proportion", "nodeorder"])
+        for step in range(self.N_STEPS):
+            for _ in range(rng.randrange(4)):
+                self._apply_random_delta(rng, (a, b), state)
+            if step % 3 == 2:
+                # full session through the statement path on both caches
+                for c in (a, b):
+                    ssn = open_session(c, make_tiers(*tiers))
+                    get_action("allocate").execute(ssn)
+                    close_session(ssn)
+                assert a.binder.binds == b.binder.binds, f"step {step}"
+            sa, sb = a.snapshot(), b.snapshot()
+            assert _digest(sa) == _digest(sb), f"step {step}"
+            assert _axis_digest(sa.node_axis) == _axis_digest(sb.node_axis), \
+                f"step {step}"
+            if step % 6 == 5:
+                # full delta-maintained ENCODE vs from-scratch rebuild+
+                # encode: the device-feed arrays must be bit-identical
+                _assert_encodes_equal(a, b, ctx=f"step {step}")
+        _assert_encodes_equal(a, b, ctx="final")
+        assert a.snap_keeper.stats["incremental"] > 0
+        assert a.snap_keeper.stats["reused_jobs"] > 0
+
+
+class TestConsecutiveRoundsSessions:
+    def _populate(self, c):
+        c.add_queue(build_queue("default"))
+        for g in range(12):
+            pg = f"job-{g:04d}"
+            c.add_pod_group(build_pod_group(pg, namespace="bench",
+                                            min_member=2))
+            for i in range(4):
+                c.add_pod(build_pod(
+                    "bench", f"{pg}-t{i}", "", objects.POD_PHASE_PENDING,
+                    build_resource_list("500m", "512Mi"), pg))
+        for n in range(6):
+            c.add_node(build_node(
+                f"node-{n:03d}",
+                build_resource_list_with_pods("16", "32Gi", pods=64)))
+
+    def _session(self, cache):
+        ssn = open_session(cache, make_tiers(
+            ["tpuscore"], *DEFAULT_TIERS, arguments=ROUNDS_ARGS))
+        get_action("allocate").execute(ssn)
+        prof = dict(ssn.plugins["tpuscore"].profile)
+        close_session(ssn)
+        return prof
+
+    def test_three_sessions_reuse_and_stay_warm(self):
+        from volcano_tpu.utils.jaxcompile import CompileWatcher
+
+        cache = make_cache()
+        self._populate(cache)
+        prof1 = self._session(cache)
+        assert prof1.get("mode") == "rounds", prof1
+        binds1 = dict(cache.binder.binds)
+        assert binds1
+        ks = cache.snap_keeper
+
+        watcher = CompileWatcher.install()
+        cloned_before = ks.stats["cloned_jobs"]
+        with watcher.assert_no_compiles("steady-state incremental sessions"):
+            self._session(cache)
+            self._session(cache)
+        # the flush synced the bulk placements, so sessions 2-3 reused the
+        # whole snapshot: no job re-clones, no new binds, no lost binds
+        assert ks.stats["cloned_jobs"] == cloned_before
+        assert ks.stats["incremental"] >= 2
+        assert dict(cache.binder.binds) == binds1
+        # cache accounting stayed per-task exact through the mirror flush
+        for node in cache.nodes.values():
+            replay = node.clone_replay()
+            assert _res_tuple(node.idle) == _res_tuple(replay.idle), node.name
+            assert _res_tuple(node.used) == _res_tuple(replay.used), node.name
+
+
+class TestFlushSkippedPlacements:
+    """ADVICE r5 (cache.py:748): a placement whose cache twin vanished in
+    the defer window must contribute NOTHING to cache node idle/used."""
+
+    def _run(self):
+        cache = make_cache()
+        cache.add_queue(build_queue("default"))
+        for g in range(8):
+            pg = f"job-{g:03d}"
+            cache.add_pod_group(build_pod_group(pg, namespace="ns",
+                                                min_member=1))
+            for i in range(4):
+                cache.add_pod(build_pod(
+                    "ns", f"{pg}-t{i}", "", objects.POD_PHASE_PENDING,
+                    build_resource_list("500m", "512Mi"), pg))
+        for n in range(4):
+            cache.add_node(build_node(
+                f"node-{n:03d}",
+                build_resource_list_with_pods("16", "32Gi", pods=64)))
+        ssn = open_session(cache, make_tiers(
+            ["tpuscore"], *DEFAULT_TIERS, arguments=ROUNDS_ARGS))
+        get_action("allocate").execute(ssn)
+        assert cache._pending_mirrors, "bulk apply should defer its mirror"
+        p = cache._pending_mirrors[0]
+        # delete one placed task's cache twin inside the defer window,
+        # bypassing the watch path (which would flush first): this is the
+        # race the flush must tolerate per-task
+        k = 0
+        ti = int(p["placed"][k])
+        task = p["task_infos"][ti]
+        host = p["node_names"][int(p["assign"][ti])]
+        cache_job = cache.jobs[task.job]
+        cache_job.delete_task_info(cache_job.tasks[task.uid])
+        close_session(ssn)  # flush runs here
+        return cache, task, host
+
+    def _check(self, cache, task, host):
+        node = cache.nodes[host]
+        assert task.key not in node.tasks
+        replay = node.clone_replay()
+        assert _res_tuple(node.idle) == _res_tuple(replay.idle)
+        assert _res_tuple(node.used) == _res_tuple(replay.used)
+        # job accounting is per-flipped too: allocated excludes the
+        # deleted task (its sums were settled by delete_task_info)
+        job = cache.jobs[task.job]
+        jreplay = job.clone_replay()
+        assert _res_tuple(job.allocated) == _res_tuple(jreplay.allocated)
+        assert _res_tuple(job.pending_sum) == _res_tuple(jreplay.pending_sum)
+        # and the keeper re-dirties the affected job/node so the next
+        # snapshot re-clones them from cache truth
+        assert task.job in cache.snap_keeper.dirty_jobs
+        assert host in cache.snap_keeper.dirty_nodes
+
+    def test_native_flush_skips_deleted_task(self):
+        from volcano_tpu import _native
+
+        if _native.get_fastapply() is None:
+            pytest.skip("native fastapply unavailable")
+        self._check(*self._run())
+
+    def test_python_flush_skips_deleted_task(self, monkeypatch):
+        from volcano_tpu import _native
+
+        monkeypatch.setenv("VOLCANO_TPU_NO_NATIVE", "1")
+        _native._reset()
+        try:
+            self._check(*self._run())
+        finally:
+            monkeypatch.delenv("VOLCANO_TPU_NO_NATIVE", raising=False)
+            _native._reset()

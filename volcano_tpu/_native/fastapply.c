@@ -916,7 +916,23 @@ done:
  * pops from the task's ACTUAL current bucket with update_task_status's
  * boundary rules (alloc_mask gates the allocated add; only tasks leaving
  * PENDING shrink pending_sum) — identical to the Python fallback loop,
- * which stays as the oracle. Caller holds the cache lock. */
+ * which stays as the oracle. Caller holds the cache lock.
+ *
+ * Returns the list of SKIPPED placed-positions (indices into `placed`):
+ * placements whose cache twin vanished in the defer window (task deleted,
+ * or the whole job gone). The caller excludes exactly these from the node
+ * idle/used deltas so cache accounting stays per-flipped-task. */
+static int
+append_idx(PyObject *list, int64_t k)
+{
+    PyObject *o = PyLong_FromLongLong((long long)k);
+    if (o == NULL)
+        return -1;
+    int rc = PyList_Append(list, o);
+    Py_DECREF(o);
+    return rc;
+}
+
 static PyObject *
 mirror_all_jobs(PyObject *self, PyObject *args)
 {
@@ -938,9 +954,14 @@ mirror_all_jobs(PyObject *self, PyObject *args)
     PyObject **ctasks_n = NULL;
     char *cresolved = NULL;
     PyObject *ret = NULL;
+    PyObject *skipped = PyList_New(0);
 
-    if (get_i64(job_nz_o, &job_nz_b, "job_nz") < 0)
+    if (skipped == NULL)
         return NULL;
+    if (get_i64(job_nz_o, &job_nz_b, "job_nz") < 0) {
+        Py_DECREF(skipped);
+        return NULL;
+    }
     if (get_i64(seg_ends_o, &seg_ends_b, "seg_ends") < 0)
         goto done;
     if (get_i64(placed_o, &placed_b, "placed") < 0)
@@ -986,6 +1007,9 @@ mirror_all_jobs(PyObject *self, PyObject *args)
         if (cache_job == NULL) {
             if (PyErr_Occurred())
                 goto done;
+            for (int64_t k = lo; k < hi; k++)
+                if (append_idx(skipped, k) < 0)
+                    goto done;
             lo = hi;  /* job no longer in the cache: skip its segment */
             continue;
         }
@@ -1023,6 +1047,8 @@ mirror_all_jobs(PyObject *self, PyObject *args)
             if (ctask == NULL) {
                 Py_DECREF(uid);
                 if (PyErr_Occurred())
+                    goto job_fail;
+                if (append_idx(skipped, k) < 0)
                     goto job_fail;
                 continue;  /* deleted in the defer window: its sums were
                             * settled by delete_task_info already */
@@ -1227,9 +1253,10 @@ mirror_all_jobs(PyObject *self, PyObject *args)
         goto done;
     }
 
-    ret = Py_None;
-    Py_INCREF(ret);
+    ret = skipped;
+    skipped = NULL;
 done:
+    Py_XDECREF(skipped);
     if (ctasks_n) {
         for (Py_ssize_t i = 0; i < n_nodes; i++)
             Py_XDECREF(ctasks_n[i]);

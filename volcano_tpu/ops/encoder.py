@@ -142,6 +142,26 @@ def _resource_vec(res: Resource, names: List[str]) -> np.ndarray:
     return np.array([res.get(n) for n in names], np.float64)
 
 
+# R -> (eps, is_scalar, res_unit); tiny and bounded by the handful of
+# resource dimensionalities a deployment ever sees
+_CONF_ARRAYS: Dict[int, tuple] = {}
+
+
+def _conf_arrays(R: int) -> tuple:
+    cached = _CONF_ARRAYS.get(R)
+    if cached is None:
+        eps = np.array(
+            [MIN_MILLI_CPU, MIN_MEMORY] + [MIN_MILLI_SCALAR] * (R - 2),
+            np.float64)
+        is_scalar = np.array([False, False] + [True] * (R - 2))
+        # integer quantization units for the rounds solver's exact cumsums:
+        # milli-cpu, MiB, milli-scalar (eps/res_unit == 10 in every dim)
+        res_unit = np.array([1.0, 1024.0 * 1024.0] + [1.0] * (R - 2),
+                            np.float64)
+        cached = _CONF_ARRAYS[R] = (eps, is_scalar, res_unit)
+    return cached
+
+
 def _qualifying_anti_terms(pod, batch_on: bool):
     """The required anti-affinity terms of `pod` IF it is device-placeable
     as an exclusion group member, else None.
@@ -772,13 +792,10 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
         # residue exactly as before
         task_excl = np.full(t_count, -1, np.int32)
 
-    eps = np.array(
-        [MIN_MILLI_CPU, MIN_MEMORY] + [MIN_MILLI_SCALAR] * (R - 2), np.float64
-    )
-    is_scalar = np.array([False, False] + [True] * (R - 2))
-    # integer quantization units for the rounds solver's exact cumsums:
-    # milli-cpu, MiB, milli-scalar (eps/res_unit == 10 in every dim)
-    res_unit = np.array([1.0, 1024.0 * 1024.0] + [1.0] * (R - 2), np.float64)
+    # constant per dimensionality; memoized so steady-state sessions hand
+    # the SAME ndarray objects to the solver (its pack-identity cache then
+    # skips re-packing the conf group)
+    eps, is_scalar, res_unit = _conf_arrays(R)
     task_nz_cpu = np.where(task_req[:, 0] != 0, task_req[:, 0],
                            nodeorder_mod.DEFAULT_MILLI_CPU_REQUEST)
     task_nz_mem = np.where(task_req[:, 1] != 0, task_req[:, 1],
@@ -949,6 +966,15 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
     # ---- node state (column-wise fills, like the task arrays) --------------
     def _node_matrix(attr: str) -> np.ndarray:
         if axis is not None:
+            # memoized per (attr, dims) on the axis at its current epoch:
+            # the keeper patches the axis in place and bumps the epoch
+            # (clearing mat_cache), so an unchanged axis hands back the
+            # SAME matrix objects session after session — the solver's
+            # pack-identity cache rides on that to skip re-packing
+            mkey = (attr, R, tuple(rnames[2:]))
+            m = axis.mat_cache.get(mkey)
+            if m is not None:
+                return m
             cap_attr = "alloc" if attr == "allocatable" else attr
             m = np.zeros((n_count, R), np.float64)
             m[:, 0] = axis.cpu[cap_attr]
@@ -958,6 +984,7 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
                 col = cols.get(rn)
                 if col is not None:
                     m[:, si] = col
+            axis.mat_cache[mkey] = m
             return m
         if not nodes:
             return np.zeros((0, R))
@@ -1000,8 +1027,14 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
                 "total pending request exceeds the limb-exact cumsum range "
                 f"({total_req_q.max():.3g} units)")
     if axis is not None:
-        node_cnt = axis.node_cnt
-        node_max_tasks = axis.max_tasks
+        # epoch-gated COPIES: the keeper patches axis.node_cnt/max_tasks
+        # in place between sessions, and the solver's pack-identity cache
+        # must only ever see arrays whose identity implies their content
+        cm = axis.mat_cache.get("cnt_max")
+        if cm is None:
+            cm = axis.mat_cache["cnt_max"] = (
+                axis.node_cnt.copy(), axis.max_tasks.copy())
+        node_cnt, node_max_tasks = cm
     else:
         node_cnt = np.array([len(n.tasks) for n in nodes], np.int32)
         node_max_tasks = np.array(
@@ -1153,9 +1186,9 @@ def encode_session(ssn, allow_residue: bool = False) -> EncodedSnapshot:
         ) if t_count else np.zeros(0, np.int32),
         sig_mask=sig_mask,
         affinity_score=affinity_score,
-        node_idle=node_idle.astype(np.float64),
-        node_used=node_used.astype(np.float64),
-        node_alloc=node_alloc.astype(np.float64),
+        node_idle=node_idle.astype(np.float64, copy=False),
+        node_used=node_used.astype(np.float64, copy=False),
+        node_alloc=node_alloc.astype(np.float64, copy=False),
         node_cnt=node_cnt,
         node_max_tasks=node_max_tasks,
         node_real=np.ones(n_count, bool),

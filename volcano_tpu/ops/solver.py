@@ -140,6 +140,18 @@ for _g, _names in {
 # replaced in place when content changes — bounded.
 _DEVICE_CACHE: Dict[str, tuple] = {}
 
+# packed-buffer reuse across sessions, keyed on the IDENTITY of the member
+# arrays: with the snapshot keeper's long-lived node axis, the encoder
+# returns the SAME ndarray objects for unchanged groups (node matrices,
+# conf constants), so an identity-equal part list means the concatenated
+# buffer is unchanged — skip the concat+astype, and _stage's byte compare
+# against the device cache then degenerates to a cheap equal-array check.
+# Arrays are never mutated in place once handed to the pack (the axis
+# bumps its epoch and rebuilds matrices instead), which is what makes
+# identity a sound proxy for content here. Holding the part refs keeps the
+# ids stable; one entry per packed key — bounded like _DEVICE_CACHE.
+_PACK_CACHE: Dict[str, tuple] = {}
+
 
 def _pack(arrays: Dict[str, np.ndarray]):
     """Pack arrays into one flat buffer per (group, dtype class). The PJRT
@@ -150,6 +162,7 @@ def _pack(arrays: Dict[str, np.ndarray]):
     is the static tuple consumed by rounds.solve_rounds_packed; bufs maps
     "group.kind" -> flat ndarray."""
     parts: Dict[str, list] = {}
+    srcs: Dict[str, list] = {}
     offsets: Dict[str, int] = {}
     layout = []
     for name in sorted(arrays):
@@ -159,9 +172,15 @@ def _pack(arrays: Dict[str, np.ndarray]):
         flat = v.ravel()
         layout.append((name, key, offsets.get(key, 0), flat.size, v.shape))
         parts.setdefault(key, []).append(flat)
-        offsets[key] = offsets.get(key, 0) + flat.size
+        srcs.setdefault(key, []).append(v)  # ravel() views get fresh ids;
+        offsets[key] = offsets.get(key, 0) + flat.size  # token on sources
     bufs = {}
     for key, ps in parts.items():
+        token = tuple(map(id, srcs[key]))
+        cached = _PACK_CACHE.get(key)
+        if cached is not None and cached[0] == token:
+            bufs[key] = cached[2]
+            continue
         kind = key[-1]
         if kind == "f":
             dt = np.result_type(*[p.dtype for p in ps])
@@ -169,7 +188,9 @@ def _pack(arrays: Dict[str, np.ndarray]):
             dt = np.bool_
         else:
             dt = np.int32
-        bufs[key] = np.concatenate(ps).astype(dt, copy=False)
+        buf = np.concatenate(ps).astype(dt, copy=False)
+        _PACK_CACHE[key] = (token, srcs[key], buf)
+        bufs[key] = buf
     return tuple(layout), bufs
 
 
@@ -245,7 +266,12 @@ class BatchAllocator:
         out = {}
         for k, v in arrays.items():
             v = np.asarray(v)
-            out[k] = v.astype(dtype) if v.dtype == np.float64 else v
+            # copy=False keeps the IDENTITY of already-typed arrays stable
+            # across sessions, which is what lets _pack's identity-token
+            # cache recognize unchanged groups (the encoder reuses its
+            # node/conf arrays between sessions when nothing moved)
+            out[k] = v.astype(dtype, copy=False) \
+                if v.dtype == np.float64 else v
         return out
 
     def _shard(self, arrays: Dict[str, np.ndarray]) -> Dict[str, object]:
@@ -712,18 +738,54 @@ class BatchAllocator:
         self.profile["apply_loop_s"] = time.perf_counter() - prof_t1
         prof_t2 = time.perf_counter()
 
+        # --- bulk node accounting (session tree; cache tree deferred) -----
+        # runs BEFORE the mirror defer so the payload can capture the final
+        # session-side node generations (the keeper's sync point)
+        node_nz = np.nonzero(counts)[0]
+        fast_nodes = getattr(mod, "apply_node_deltas", None) \
+            if mod is not None else None
+        if fast_nodes is not None:
+            fast_nodes(node_nz, np.ascontiguousarray(sums),
+                       node_names, ssn_nodes,
+                       cache_nodes if do_cache_inline else None,
+                       tuple(scalar_names))
+        else:
+            sums_l = sums.tolist()
+            for ni in node_nz.tolist():
+                vec = sums_l[ni]
+                name = node_names[ni]
+                nodes_pair = (ssn_nodes.get(name), cache_nodes.get(name)) \
+                    if do_cache_inline else (ssn_nodes.get(name),)
+                for node in nodes_pair:
+                    if node is None:
+                        continue
+                    node._acct_gen += 1  # invalidate snapshot node-axis
+                    apply_delta(node.idle, vec, -1.0)
+                    apply_delta(node.used, vec, +1.0)
+
         if not do_cache_inline:
             # queued only after the session-side loop SUCCEEDED (a loop
             # failure must not leave the cache applying phantom
             # placements), and before any effector runs — a store-backed
             # binder can fire synchronous watch events whose handlers
-            # flush_mirror(), and they must land on a synced mirror
+            # flush_mirror(), and they must land on a synced mirror.
+            # job_vers/node_gens are the session-side versions at this
+            # point (all bulk mutations applied): after an exact flush the
+            # cache twins equal these objects, so the snapshot keeper can
+            # re-record them as in-sync and reuse them next open.
+            # placed_req rows let the flush subtract any placement it had
+            # to skip (pod deleted in the defer window) from the node sums.
             defer_mirror(dict(
                 job_nz=job_nz_arr, seg_ends=seg_ends_arr, placed=placed_arr,
                 assign=assign, task_infos=task_infos, node_names=node_names,
                 job_infos=job_infos, job_sums=job_sums,
                 scalar_names=tuple(scalar_names),
-                node_nz=np.nonzero(counts)[0], node_sums=sums))
+                node_nz=node_nz, node_sums=sums,
+                placed_req=reqs,
+                job_vers=[job_infos[ji]._status_version
+                          for ji in job_nz],
+                node_gens=[ssn_nodes[node_names[ni]]._acct_gen
+                           for ni in node_nz.tolist()]))
             self.profile["mirror_deferred"] = 1
 
         # --- batch binder + events ----------------------------------------
@@ -793,29 +855,6 @@ class BatchAllocator:
 
         self.profile["apply_bind_s"] = time.perf_counter() - prof_t2
         prof_t3 = time.perf_counter()
-
-        # --- bulk node accounting (session tree; cache tree deferred) -----
-        node_nz = np.nonzero(counts)[0]
-        fast_nodes = getattr(mod, "apply_node_deltas", None) \
-            if mod is not None else None
-        if fast_nodes is not None:
-            fast_nodes(node_nz, np.ascontiguousarray(sums),
-                       node_names, ssn_nodes,
-                       cache_nodes if do_cache_inline else None,
-                       tuple(scalar_names))
-        else:
-            sums_l = sums.tolist()
-            for ni in node_nz.tolist():
-                vec = sums_l[ni]
-                name = node_names[ni]
-                nodes_pair = (ssn_nodes.get(name), cache_nodes.get(name)) \
-                    if do_cache_inline else (ssn_nodes.get(name),)
-                for node in nodes_pair:
-                    if node is None:
-                        continue
-                    node._acct_gen += 1  # invalidate snapshot node-axis
-                    apply_delta(node.idle, vec, -1.0)
-                    apply_delta(node.used, vec, +1.0)
 
         # --- bulk plugin share updates (drf / proportion) -----------------
         # per-job DRF shares must be exact per job; namespace/queue shares

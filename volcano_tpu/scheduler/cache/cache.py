@@ -270,8 +270,13 @@ class SchedulerCache:
             else (StoreVolumeBinder(store) if store else DefaultVolumeBinder()))
 
         from volcano_tpu.scheduler.cache.podtable import PodTable
+        from volcano_tpu.scheduler.cache.snapkeeper import SnapshotKeeper
 
         self.pod_table = PodTable()
+        # delta-maintained session snapshot (snapkeeper.py): watch/effector
+        # mutation paths below mark the touched job/node so snapshot()
+        # re-clones only what moved since the last session
+        self.snap_keeper = SnapshotKeeper()
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
         self.queues: Dict[str, QueueInfo] = {}
@@ -329,6 +334,8 @@ class SchedulerCache:
         return self.jobs[ti.job]
 
     def _add_task(self, ti: TaskInfo) -> None:
+        self.snap_keeper.mark_job(ti.job)
+        self.snap_keeper.mark_node(ti.node_name)
         job = self._get_or_create_job(ti)
         if job is not None:
             job.add_task_info(ti)
@@ -346,6 +353,8 @@ class SchedulerCache:
                 self.nodes[ti.node_name].add_task(ti)
 
     def _delete_task(self, ti: TaskInfo) -> None:
+        self.snap_keeper.mark_job(ti.job)
+        self.snap_keeper.mark_node(ti.node_name)
         if ti.pod is not None and any(
                 v.persistent_volume_claim for v in ti.pod.spec.volumes):
             self._pvc_pod_count = max(0, self._pvc_pod_count - 1)
@@ -418,6 +427,7 @@ class SchedulerCache:
     def add_node(self, node: objects.Node) -> None:
         self.flush_mirror()  # deferred node deltas must precede a set_node/rebuild
         with self._lock:
+            self.snap_keeper.mark_node(node.metadata.name)
             if node.metadata.name in self.nodes:
                 self.nodes[node.metadata.name].set_node(node)
             else:
@@ -429,6 +439,7 @@ class SchedulerCache:
     def delete_node(self, node: objects.Node) -> None:
         self.flush_mirror()  # see add_node
         with self._lock:
+            self.snap_keeper.mark_node(node.metadata.name)
             self.nodes.pop(node.metadata.name, None)
 
     # -- podgroup handlers (event_handlers.go:159-196) ---------------------
@@ -436,6 +447,7 @@ class SchedulerCache:
     def add_pod_group(self, pg: objects.PodGroup) -> None:
         with self._lock:
             job_id = pod_group_job_id(pg)
+            self.snap_keeper.mark_job(job_id)
             if job_id not in self.jobs:
                 self.jobs[job_id] = JobInfo(job_id)
             job = self.jobs[job_id]
@@ -450,6 +462,7 @@ class SchedulerCache:
         self.flush_mirror()  # job deletion must see flushed task state
         with self._lock:
             job_id = pod_group_job_id(pg)
+            self.snap_keeper.mark_job(job_id)
             job = self.jobs.get(job_id)
             if job is None:
                 return
@@ -460,6 +473,11 @@ class SchedulerCache:
 
     def add_queue(self, queue: objects.Queue) -> None:
         with self._lock:
+            if queue.metadata.name not in self.queues:
+                # queue SET changes flip job eligibility cluster-wide;
+                # updates of an existing queue don't (QueueInfos are
+                # re-cloned fresh every snapshot regardless)
+                self.snap_keeper.invalidate()
             self.queues[queue.metadata.name] = QueueInfo(queue)
 
     def update_queue_from_watch(self, old: objects.Queue, new: objects.Queue) -> None:
@@ -467,12 +485,17 @@ class SchedulerCache:
 
     def delete_queue(self, queue: objects.Queue) -> None:
         with self._lock:
+            if queue.metadata.name in self.queues:
+                self.snap_keeper.invalidate()
             self.queues.pop(queue.metadata.name, None)
 
     # -- priority class handlers (event_handlers.go) -----------------------
 
     def add_priority_class(self, pc: objects.PriorityClass) -> None:
         with self._lock:
+            # job.priority derives from the PC set at snapshot time; the
+            # dirty-sets don't model that dependency, so rebuild wholesale
+            self.snap_keeper.invalidate()
             self.priority_classes[pc.metadata.name] = pc
             if pc.global_default:
                 self.default_priority = pc.value
@@ -482,6 +505,7 @@ class SchedulerCache:
 
     def delete_priority_class(self, pc: objects.PriorityClass) -> None:
         with self._lock:
+            self.snap_keeper.invalidate()
             self.priority_classes.pop(pc.metadata.name, None)
             if pc.global_default:
                 self.default_priority = 0
@@ -510,6 +534,7 @@ class SchedulerCache:
     def add_pdb(self, pdb: objects.PodDisruptionBudget) -> None:
         with self._lock:
             job_id = f"{pdb.metadata.namespace}/{pdb.metadata.name}"
+            self.snap_keeper.mark_job(job_id)
             if job_id not in self.jobs:
                 self.jobs[job_id] = JobInfo(job_id)
             self.jobs[job_id].set_pdb(pdb)
@@ -520,6 +545,7 @@ class SchedulerCache:
     def delete_pdb(self, pdb: objects.PodDisruptionBudget) -> None:
         with self._lock:
             job_id = f"{pdb.metadata.namespace}/{pdb.metadata.name}"
+            self.snap_keeper.mark_job(job_id)
             job = self.jobs.get(job_id)
             if job is None:
                 return
@@ -529,6 +555,7 @@ class SchedulerCache:
     # -- job cleanup (cache.go:656-688) ------------------------------------
 
     def _delete_job(self, job: JobInfo) -> None:
+        self.snap_keeper.mark_job(job.uid)
         self._deleted_jobs.append(job)
         self._process_cleanup_jobs()
 
@@ -571,6 +598,8 @@ class SchedulerCache:
         failure, queue the task for resync (cache.go:558-613)."""
         mirror = self._mirror()
         with self._lock:
+            self.snap_keeper.mark_job(task_info.job)
+            self.snap_keeper.mark_node(hostname)
             if mirror is not None:
                 task, pod = mirror.mirror_bind(task_info, hostname)
             else:
@@ -596,6 +625,8 @@ class SchedulerCache:
     def evict(self, task_info: TaskInfo, reason: str) -> None:
         mirror = self._mirror()
         with self._lock:
+            self.snap_keeper.mark_job(task_info.job)
+            self.snap_keeper.mark_node(task_info.node_name)
             if mirror is not None:
                 task, pod = mirror.mirror_evict(task_info)
             else:
@@ -716,12 +747,23 @@ class SchedulerCache:
         as the effectors and watch handlers). Ordering with interleaved
         effector calls is safe: bulk-bound tasks are disjoint from the
         tasks bind/evict touch, and the node deltas here move idle/used
-        while evictions move releasing."""
+        while evictions move releasing.
+
+        Accounting is PER FLIPPED TASK on both the job AND node side: a
+        placed task whose cache twin vanished in the defer window (pod
+        deleted) contributes nothing here — its sums were settled by
+        delete_task_info — so node idle/used never drifts from the
+        sum-over-held-tasks invariant the incremental snapshot relies on.
+        After an exact flush the cache twins equal the session objects, so
+        the snapshot keeper records them as in-sync (the payload carries
+        the session-side versions captured at defer time); any skipped
+        task re-dirties its job and node instead."""
         with self._lock:
             pending, self._pending_mirrors = self._pending_mirrors, []
             if not pending:
                 return
             BINDING = TaskStatus.BINDING
+            keeper = self.snap_keeper
             # native batched flush (fastapply.c mirror_all_jobs /
             # apply_node_deltas): identical semantics to the Python body
             # below, which remains the fallback and oracle. Non-blocking —
@@ -732,111 +774,139 @@ class SchedulerCache:
             mod = get_fastapply_nowait()
             mirror_all = getattr(mod, "mirror_all_jobs", None) \
                 if mod is not None else None
-            if mirror_all is not None:
-                alloc_mask = (int(TaskStatus.BOUND) | int(TaskStatus.BINDING)
-                              | int(TaskStatus.RUNNING)
-                              | int(TaskStatus.ALLOCATED))
-                for p in pending:
-                    mirror_all(
-                        p["job_nz"], p["seg_ends"], p["placed"],
-                        p["assign"].astype(np.int64, copy=False),
-                        p["task_infos"], p["node_names"], self.nodes,
-                        p["job_infos"], self.jobs,
-                        TaskStatus.PENDING, BINDING,
-                        np.ascontiguousarray(p["job_sums"]),
-                        tuple(p["scalar_names"]), alloc_mask)
-                    mod.apply_node_deltas(
-                        p["node_nz"], np.ascontiguousarray(p["node_sums"]),
-                        p["node_names"], self.nodes, None,
-                        tuple(p["scalar_names"]))
-                return
+            alloc_mask = (int(TaskStatus.BOUND) | int(TaskStatus.BINDING)
+                          | int(TaskStatus.RUNNING)
+                          | int(TaskStatus.ALLOCATED))
             for p in pending:
                 task_infos = p["task_infos"]
                 node_names = p["node_names"]
-                assign = p["assign"]
-                placed = p["placed"].tolist()
                 scalar_names = p["scalar_names"]
-                lo = 0
-                for ji, hi in zip(p["job_nz"].tolist(),
-                                  p["seg_ends"].tolist()):
-                    tis = placed[lo:hi]
-                    lo = hi
-                    job = p["job_infos"][ji]
-                    cache_job = self.jobs.get(job.uid)
-                    if cache_job is None:
-                        continue
-                    cache_job._status_version += 1
-                    cidx = cache_job.task_status_index
-                    c_tasks = cache_job.tasks
-                    for ti in tis:
-                        task = task_infos[ti]
-                        ctask = c_tasks.get(task.uid)
-                        if ctask is None:
-                            # the pod was deleted in the defer window;
-                            # delete_task_info already settled its sums
+                skipped: List[int] = []
+                if mirror_all is not None:
+                    skipped = mirror_all(
+                        p["job_nz"], p["seg_ends"], p["placed"],
+                        p["assign"].astype(np.int64, copy=False),
+                        task_infos, node_names, self.nodes,
+                        p["job_infos"], self.jobs,
+                        TaskStatus.PENDING, BINDING,
+                        np.ascontiguousarray(p["job_sums"]),
+                        tuple(scalar_names), alloc_mask) or []
+                else:
+                    assign = p["assign"]
+                    placed = p["placed"].tolist()
+                    lo = 0
+                    for ji, hi in zip(p["job_nz"].tolist(),
+                                      p["seg_ends"].tolist()):
+                        tis = placed[lo:hi]
+                        seg_lo = lo
+                        lo = hi
+                        job = p["job_infos"][ji]
+                        cache_job = self.jobs.get(job.uid)
+                        if cache_job is None:
+                            skipped.extend(range(seg_lo, hi))
                             continue
-                        host = node_names[int(assign[ti])]
-                        old_status = ctask.status
-                        old_bucket = cidx.get(old_status)
-                        if old_bucket is not None:
-                            old_bucket.pop(ctask.uid, None)
-                            if not old_bucket:
-                                del cidx[old_status]
-                        ctask.node_name = host
-                        ctask.status = BINDING
-                        cidx.setdefault(BINDING, {})[ctask.uid] = ctask
-                        # accounting moves are PER FLIPPED TASK with the
-                        # same boundary rules as update_task_status, not
-                        # the session's job_sums vector: a placed task
-                        # deleted or re-statused in the defer window must
-                        # not be double-counted
-                        if not allocated_status(old_status):
-                            cache_job.allocated.add(ctask.resreq)
-                        if old_status == TaskStatus.PENDING:
-                            cache_job.pending_sum.sub(ctask.resreq)
-                        cnode = self.nodes.get(host)
-                        if cnode is not None:
-                            cnode._acct_gen += 1
-                            # the session task is shared into the cache node
-                            # map, exactly as the inline writeback did
-                            cnode.tasks[task.key] = task
-                sums = p["node_sums"].tolist()
-                for ni in p["node_nz"].tolist():
-                    cnode = self.nodes.get(node_names[ni])
-                    if cnode is None:
-                        continue
-                    cnode._acct_gen += 1
-                    vec = sums[ni]
-                    _add_res_vec(cnode.idle, vec, -1.0, scalar_names)
-                    _add_res_vec(cnode.used, vec, +1.0, scalar_names)
+                        cache_job._status_version += 1
+                        cidx = cache_job.task_status_index
+                        c_tasks = cache_job.tasks
+                        for k, ti in enumerate(tis, start=seg_lo):
+                            task = task_infos[ti]
+                            ctask = c_tasks.get(task.uid)
+                            if ctask is None:
+                                # the pod was deleted in the defer window;
+                                # delete_task_info settled its sums
+                                skipped.append(k)
+                                continue
+                            host = node_names[int(assign[ti])]
+                            old_status = ctask.status
+                            old_bucket = cidx.get(old_status)
+                            if old_bucket is not None:
+                                old_bucket.pop(ctask.uid, None)
+                                if not old_bucket:
+                                    del cidx[old_status]
+                            ctask.node_name = host
+                            ctask.status = BINDING
+                            cidx.setdefault(BINDING, {})[ctask.uid] = ctask
+                            # per-flipped-task boundary rules, exactly as
+                            # update_task_status moves the sums
+                            if not allocated_status(old_status):
+                                cache_job.allocated.add(ctask.resreq)
+                            if old_status == TaskStatus.PENDING:
+                                cache_job.pending_sum.sub(ctask.resreq)
+                            cnode = self.nodes.get(host)
+                            if cnode is not None:
+                                cnode._acct_gen += 1
+                                # the session task is shared into the cache
+                                # node map, as the inline writeback did
+                                cnode.tasks[task.key] = task
+                self._flush_node_deltas(p, skipped, mod)
+                self._flush_sync_keeper(p, skipped, keeper)
+
+    def _flush_node_deltas(self, p: dict, skipped: List[int], mod) -> None:
+        """Node idle/used deltas for one payload, restricted to the tasks
+        the mirror pass actually flipped: skipped placements (cache twin
+        deleted in the defer window) are subtracted from the session's
+        wholesale per-node sums before they land on the cache nodes."""
+        node_names = p["node_names"]
+        scalar_names = p["scalar_names"]
+        node_sums = p["node_sums"]
+        if skipped:
+            placed_req = p.get("placed_req")
+            if placed_req is not None:
+                node_sums = node_sums.copy()
+                placed = p["placed"]
+                assign = p["assign"]
+                for k in skipped:
+                    node_sums[int(assign[int(placed[k])])] -= placed_req[k]
+            # else: a legacy payload without per-task reqs; the wholesale
+            # sums are applied and the touched nodes are re-cloned next
+            # open anyway (skipped marks them dirty below)
+        fast_nodes = getattr(mod, "apply_node_deltas", None) \
+            if mod is not None else None
+        if fast_nodes is not None:
+            fast_nodes(p["node_nz"], np.ascontiguousarray(node_sums),
+                       node_names, self.nodes, None, tuple(scalar_names))
+            return
+        sums = node_sums.tolist()
+        for ni in p["node_nz"].tolist():
+            cnode = self.nodes.get(node_names[ni])
+            if cnode is None:
+                continue
+            cnode._acct_gen += 1
+            vec = sums[ni]
+            _add_res_vec(cnode.idle, vec, -1.0, scalar_names)
+            _add_res_vec(cnode.used, vec, +1.0, scalar_names)
+
+    def _flush_sync_keeper(self, p: dict, skipped: List[int],
+                           keeper) -> None:
+        """Record the flushed objects as snapshot-in-sync (versions were
+        captured at defer time, AFTER the session-side bulk mutations), so
+        the next open reuses them; skipped placements re-dirty instead."""
+        job_vers = p.get("job_vers")
+        if job_vers is not None:
+            job_infos = p["job_infos"]
+            for ji, ver in zip(p["job_nz"].tolist(), job_vers):
+                keeper.sync_job(job_infos[ji].uid, ver)
+        node_gens = p.get("node_gens")
+        if node_gens is not None:
+            node_names = p["node_names"]
+            for ni, gen in zip(p["node_nz"].tolist(), node_gens):
+                keeper.sync_node(node_names[ni], gen)
+        if skipped:
+            task_infos = p["task_infos"]
+            node_names = p["node_names"]
+            placed = p["placed"]
+            assign = p["assign"]
+            for k in skipped:
+                ti = int(placed[k])
+                keeper.mark_job(task_infos[ti].job)
+                keeper.mark_node(node_names[int(assign[ti])])
 
     def snapshot(self) -> ClusterInfo:
-        from volcano_tpu.scheduler.cache.nodeaxis import capture_node_axis
-
+        """The per-session snapshot, delta-maintained by the keeper
+        (snapkeeper.py): only jobs/nodes whose cache twins or handed-out
+        clones moved since the last session are re-cloned; the first call
+        (and any keeper invalidation) is the wholesale rebuild of
+        cache.go:713-798."""
         self.flush_mirror()
         with self._lock:
-            snap = ClusterInfo()
-            for node in self.nodes.values():
-                if not node.ready():
-                    continue
-                snap.nodes[node.name] = node.clone()
-            # columnar capture in the same pass that cloned the nodes; the
-            # encoder validates per-node generations before trusting it
-            snap.node_axis = capture_node_axis(snap.nodes)
-            for queue in self.queues.values():
-                snap.queues[queue.uid] = queue.clone()
-            for ns, coll in self.namespace_collection.items():
-                snap.namespace_info[ns] = coll.snapshot()
-            for job in self.jobs.values():
-                if job.pod_group is None and job.pdb is None:
-                    continue  # no scheduling spec
-                if job.queue not in snap.queues:
-                    continue  # queue doesn't exist
-                if job.pod_group is not None:
-                    job.priority = self.default_priority
-                    pri_name = job.pod_group.spec.priority_class_name
-                    pc = self.priority_classes.get(pri_name)
-                    if pc is not None:
-                        job.priority = pc.value
-                snap.jobs[job.uid] = job.clone()
-            return snap
+            return self.snap_keeper.snapshot(self)

@@ -37,10 +37,17 @@ F_RESIDENT_PODS = np.uint16(256)
 class NodeAxis:
     """Columns over the snapshot's ready nodes, name-sorted (the encoder's
     node order). ``scalars[attr]`` maps scalar resource name -> [N] array;
-    attrs are "idle" / "used" / "alloc"."""
+    attrs are "idle" / "used" / "alloc".
+
+    The axis is LONG-LIVED when owned by the snapshot keeper
+    (cache/snapkeeper.py): rows are patched in place between sessions for
+    the nodes that actually changed, and ``epoch`` counts content changes
+    so downstream caches (the encoder's node matrices, the solver's packed
+    buffers) can trust an unchanged-epoch axis without re-reading it."""
 
     __slots__ = ("names", "nodes", "gens", "flags", "cpu", "mem",
-                 "scalars", "scalar_names", "node_cnt", "max_tasks")
+                 "scalars", "scalar_names", "node_cnt", "max_tasks",
+                 "epoch", "mat_cache")
 
     def __init__(self, names: List[str], nodes: list, gens: np.ndarray,
                  flags: np.ndarray, cpu: Dict[str, np.ndarray],
@@ -58,6 +65,10 @@ class NodeAxis:
         self.scalar_names = scalar_names
         self.node_cnt = node_cnt
         self.max_tasks = max_tasks
+        self.epoch = 0
+        # encoder-side memo of derived per-epoch products (node matrices);
+        # invalidated wholesale when epoch moves (encoder._node_matrix)
+        self.mat_cache: dict = {}
 
     def total_alloc(self):
         """Cluster-total allocatable as (milli_cpu, memory, {scalar: sum})
@@ -131,6 +142,39 @@ def _node_flag_bits(info) -> int:
     if info.tasks:
         bits |= int(F_RESIDENT_PODS)
     return bits
+
+
+def refresh_rows(axis: NodeAxis, updates) -> bool:
+    """Patch the axis in place for ``updates`` = [(row_index, node), ...]
+    (the snapshot keeper's dirty rows). Returns False when a node carries a
+    scalar resource the axis has no column for — the caller must fall back
+    to a full ``capture_node_axis`` (new resource dimensions reshape every
+    scalar column). Bumps ``epoch`` and drops the derived-matrix memo."""
+    scalar_set = set(axis.scalar_names)
+    for _, nd in updates:
+        for field in ("idle", "used", "allocatable"):
+            sr = getattr(nd, field).scalar_resources
+            if sr and not scalar_set.issuperset(sr):
+                return False
+    for i, nd in updates:
+        axis.nodes[i] = nd
+        axis.gens[i] = nd._acct_gen
+        axis.flags[i] = _node_flag_bits(nd)
+        axis.node_cnt[i] = len(nd.tasks)
+        axis.max_tasks[i] = nd.allocatable.max_task_num
+        for attr, field in (("idle", "idle"), ("used", "used"),
+                            ("alloc", "allocatable")):
+            r = getattr(nd, field)
+            axis.cpu[attr][i] = r.milli_cpu
+            axis.mem[attr][i] = r.memory
+            cols = axis.scalars[attr]
+            sr = r.scalar_resources
+            for rn, col in cols.items():
+                col[i] = sr.get(rn, 0.0) if sr else 0.0
+    if updates:
+        axis.epoch += 1
+        axis.mat_cache.clear()
+    return True
 
 
 def capture_node_axis(nodes_by_name: Dict[str, object]) -> Optional[NodeAxis]:

@@ -229,17 +229,27 @@ class RemoteStore:
             if stopping:
                 with self._event_lock:
                     drained = not self._event_buf
-                if drained:
-                    return
+                    if drained:
+                        # drop the self-reference so a later record_event
+                        # can spawn a fresh flusher (is_alive() in
+                        # _queue_events is the belt to this suspender)
+                        if self._event_thread is threading.current_thread():
+                            self._event_thread = None
+                        return
 
     def _queue_events(self, items) -> None:
         with self._event_lock:
             self._event_buf.extend(items)
-            if self._event_thread is None:
-                self._event_thread = threading.Thread(
+            t = self._event_thread
+            if t is None or not t.is_alive():
+                # a dead thread reference (a flusher that exited after a
+                # timed-out stop_events) must not block respawning, or
+                # every later event would buffer forever
+                t = threading.Thread(
                     target=self._event_flusher, daemon=True,
                     name="remote-event-flush")
-                self._event_thread.start()
+                self._event_thread = t
+                t.start()
             if len(self._event_buf) >= 512:
                 self._event_wake.set()
 
@@ -308,14 +318,21 @@ class RemoteStore:
         ADDED (gateway _WatchJournal seeds on creation), so ``replay``
         is honored by starting from seq 0; ``replay=False`` starts from
         the journal's current head. On a journal reset (client fell
-        behind the ring buffer) the poller re-lists the kind and
-        re-delivers current objects as ADDED — the same at-least-once
-        semantic informer resyncs have; handlers must be idempotent on
-        re-ADDs, which the store-backed caches/controllers are.
+        behind the ring buffer) the poller re-lists the kind, synthesizes
+        DELETED for every previously-delivered object missing from the
+        re-list (the reflector's DeltaFIFO Replace semantic — without it
+        a burst of deletes larger than the journal ring would leave
+        phantom objects in a remote cache forever), then re-delivers the
+        current objects as ADDED — at-least-once; handlers must be
+        idempotent on re-ADDs, which the store-backed caches/controllers
+        are. A FAILED re-list retries without advancing the cursor (the
+        next poll resets again), so the gap is never silently skipped.
 
         Callbacks run on the poll thread — the same "handler runs on a
         foreign thread" contract as the in-process store, whose handlers
         run on the writer's thread."""
+        from volcano_tpu.store.store import object_key
+
         since = 0
         if not replay:
             out = self._request("GET", f"/watch/{kind}",
@@ -328,6 +345,8 @@ class RemoteStore:
         stop = self._watch_stop
 
         def _loop(since=since):
+            # last-delivered object per key — the reset path's diff base
+            known: Dict[str, object] = {}
             while not stop.is_set():
                 try:
                     out = self._request(
@@ -342,13 +361,34 @@ class RemoteStore:
                     stop.wait(1.0)
                     continue
                 if out.get("reset"):
-                    since = int(out.get("next", 0))
                     try:
-                        for obj in self.list(kind):
+                        listed = {object_key(o): o for o in self.list(kind)}
+                    except Exception as e:
+                        # do NOT advance `since`: the next poll returns
+                        # reset again and the re-list is retried, instead
+                        # of permanently skipping the journal gap
+                        logger.warning(
+                            "watch %s re-list failed (%s); retrying",
+                            kind, e)
+                        stop.wait(1.0)
+                        continue
+                    since = int(out.get("next", 0))
+                    for key in [k for k in known if k not in listed]:
+                        old = known.pop(key)
+                        try:
+                            if handler.deleted is not None:
+                                handler.deleted(old)
+                        except Exception:
+                            logger.exception(
+                                "watch %s reset-delete handler failed", kind)
+                    for key, obj in listed.items():
+                        known[key] = obj
+                        try:
                             if handler.added is not None:
                                 handler.added(obj)
-                    except Exception as e:
-                        logger.warning("watch %s re-list failed: %s", kind, e)
+                        except Exception:
+                            logger.exception(
+                                "watch %s re-list handler failed", kind)
                     continue
                 for entry in out.get("events", []):
                     try:
@@ -357,6 +397,12 @@ class RemoteStore:
                                if "object" in entry else None)
                         old = (codec.from_envelope(entry["old"])
                                if "old" in entry else None)
+                        if etype == "ADDED" and new is not None:
+                            known[object_key(new)] = new
+                        elif etype == "MODIFIED" and new is not None:
+                            known[object_key(new)] = new
+                        elif etype == "DELETED" and old is not None:
+                            known.pop(object_key(old), None)
                         if etype == "ADDED" and handler.added is not None:
                             handler.added(new)
                         elif etype == "MODIFIED" and handler.updated is not None:

@@ -37,3 +37,11 @@ def mesh_pad(a, node_multiple):
     n = a.shape[0]
     nb = ((n + node_multiple - 1) // node_multiple) * node_multiple
     return _pad_axis(a, 0, nb)  # vclint: disable=VT002 - mesh-multiple node pad; node count is deployment-stable
+
+
+def window_rounds(scores, live_nodes, spec):
+    # window widths off the bucket ladder (or the jit-static spec) are
+    # compile-stable
+    k = _bucket(len(live_nodes))
+    top = lax.top_k(scores, k)
+    return top, lax.top_k(scores, spec.window_k)

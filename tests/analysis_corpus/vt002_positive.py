@@ -25,3 +25,12 @@ def dispatch(enc, tasks, spec):
 
 def build_spec(tasks):
     return SolveSpec(round_min_progress=len(tasks))  # vclint-expect: VT002
+
+
+def window_rounds(scores, live_nodes):
+    # candidate-window sizes are jit-static shapes: a raw live count here
+    # re-keys the compiled program every churn
+    k = len(live_nodes)
+    top = lax.top_k(scores, k)  # vclint-expect: VT002
+    w = scores.shape[-1] // 4
+    return top, lax.top_k(scores, k=w)  # vclint-expect: VT002

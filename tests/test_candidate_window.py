@@ -1,0 +1,262 @@
+"""Candidate-window exactness: windowed rounds == full-width rounds, bit
+for bit.
+
+The rounds solver's top-k candidate windows + dirty-column rescoring
+(ops/rounds.py) are PRUNING devices, not sampling devices: a per-class
+coverage bit falls back to a full-width nomination whenever the windowed
+answer is not provably identical, so the solve must produce bit-identical
+assignments to the full-width solver (window_k=0) on any snapshot. The fuzz
+drives cfg2/cfg4/cfg6-shaped randomized clusters — heterogeneous requests
+(GPU scalars included), selectors/zones, exclusion groups (required
+anti-affinity -> device exclusion classes), overcommitted capacity (gang
+rollback fixpoint), multi-queue overused gating, binpack and spreading
+score policies, and the diminishing-returns cap + straggler rounds + device
+tail — through both solvers and compares raw kernel outputs.
+
+A small deterministic seed subset runs in the default tier-1 gate; the long
+randomized sweep is `-m slow` (pytest.ini marker), mirroring the scale-gate
+convention.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from tests.helpers import make_cache, make_tiers
+from volcano_tpu.api import objects
+from volcano_tpu.scheduler.framework import close_session, get_action, open_session
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list_with_pods,
+)
+from volcano_tpu.utils.jaxcompile import CompileWatcher
+
+ROUNDS_ARGS = {"tpuscore": {"tpuscore.mode": "rounds"}}
+
+# cfg5/cfg4-shaped (spreading), cfg2/cfg6-shaped (packing), cfg3-shaped
+TIER_SHAPES = (
+    (["priority", "gang"], ["drf", "predicates", "proportion", "nodeorder"]),
+    (["priority", "gang"], ["predicates", "binpack", "proportion"]),
+    (["priority", "gang"], ["drf", "proportion"]),
+)
+
+
+def _anti_affinity(labels):
+    return objects.Affinity(
+        pod_anti_affinity=objects.PodAntiAffinity(required_terms=[
+            objects.PodAffinityTerm(
+                label_selector=objects.LabelSelector(match_labels=labels),
+                topology_key="kubernetes.io/hostname",
+            )
+        ])
+    )
+
+
+def random_cluster(seed: int):
+    """cfg2/cfg4/cfg6-shaped randomized snapshot: the exclusion-group,
+    rollback, overused-queue, and heterogeneous-class shapes the window's
+    coverage fallback must survive."""
+    def populate(c):
+        rng = random.Random(seed)
+        n_nodes = rng.choice([8, 12, 24, 40])
+        n_groups = rng.choice([8, 16, 28])
+        queues = rng.choice([1, 1, 2])
+        tight = rng.random() < 0.4  # overcommit -> gang rollback fixpoint
+        for q in range(queues):
+            c.add_queue(build_queue(f"q{q}", weight=1 + q))
+        for g in range(n_groups):
+            pg = f"pg{g:03d}"
+            members = rng.choice([2, 3, 4])
+            minm = rng.choice([1, members])
+            c.add_pod_group(build_pod_group(
+                pg, namespace="ns1", min_member=minm,
+                queue=f"q{g % queues}"))
+            aff_group = rng.random() < 0.25
+            for i in range(members):
+                req = {
+                    "cpu": f"{rng.choice([250, 500, 1000, 2000])}m",
+                    "memory": rng.choice(["512Mi", "1Gi", "2Gi"]),
+                }
+                if rng.random() < 0.2:
+                    req["nvidia.com/gpu"] = str(rng.choice([1, 2]))
+                sel = ({"zone": rng.choice(["a", "b"])}
+                       if rng.random() < 0.3 else None)
+                pod = build_pod(
+                    "ns1", f"{pg}-p{i}", "", objects.POD_PHASE_PENDING,
+                    req, pg, priority=rng.choice([0, 0, 10]),
+                    node_selector=sel)
+                if aff_group:
+                    app = f"aff-{g % 5}"
+                    pod.metadata.labels["app"] = app
+                    pod.spec.affinity = _anti_affinity({"app": app})
+                c.add_pod(pod)
+        cpu, mem = ("4", "8Gi") if tight else ("16", "32Gi")
+        for n in range(n_nodes):
+            c.add_node(build_node(
+                f"node-{n:03d}",
+                build_resource_list_with_pods(
+                    cpu, mem, pods=rng.choice([8, 64]),
+                    **({"nvidia.com/gpu": "4"} if n % 3 == 0 else {})),
+                labels={"zone": "a" if n % 2 == 0 else "b"}))
+    return populate
+
+
+def _encode(populate, tiers):
+    """Snapshot -> padded rounds-kernel arrays + spec (the solver's exact
+    prep, minus the float32 cast — tests run x64 so host arithmetic
+    matches)."""
+    from volcano_tpu.ops.encoder import encode_session
+    from volcano_tpu.ops.solver import _ROUNDS_SKIP, pad_encoded
+
+    cache = make_cache()
+    populate(cache)
+    ssn = open_session(cache, make_tiers(*tiers))
+    enc = encode_session(ssn, allow_residue=True)
+    arrays = {k: v for k, v in pad_encoded(enc).items()
+              if k not in _ROUNDS_SKIP}
+    close_session(ssn)
+    return enc.spec, arrays
+
+
+def _solve(spec, arrays):
+    from volcano_tpu.ops import rounds as R
+
+    assign, n_rounds, tail_placed, full_sweeps, capped, hist = R.solve_rounds(
+        spec, arrays)
+    return (np.asarray(assign), int(n_rounds), int(tail_placed),
+            int(full_sweeps), bool(capped), np.asarray(hist))
+
+
+def assert_window_parity(seed, window_k=8, dirty_k=16, min_progress=0,
+                         stragglers=0):
+    tiers = TIER_SHAPES[seed % len(TIER_SHAPES)]
+    spec, arrays = _encode(random_cluster(seed), tiers)
+    n = int(arrays["node_idle"].shape[0])
+    spec = spec._replace(round_min_progress=min_progress,
+                         straggler_rounds=stragglers)
+    full = _solve(spec._replace(window_k=0, dirty_k=0), arrays)
+    win = _solve(spec._replace(window_k=min(window_k, n),
+                               dirty_k=min(dirty_k, n)), arrays)
+    assert np.array_equal(full[0], win[0]), (
+        f"seed {seed}: windowed bindings diverge from full-width "
+        f"({int((full[0] != win[0]).sum())} tasks differ; "
+        f"rounds {full[1]} vs {win[1]})")
+    # exactness means the whole round TRAJECTORY matches, not just the end
+    # state: same round count, same placed-per-round histogram
+    assert full[1] == win[1], (seed, full[1], win[1])
+    assert np.array_equal(full[5], win[5]), (seed, full[5], win[5])
+    return full, win
+
+
+class TestWindowParityGate:
+    """Small deterministic subset — runs in the default tier-1 gate."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_windowed_bindings_bit_identical(self, seed):
+        assert_window_parity(seed)
+
+    def test_parity_with_cap_and_straggler_rounds(self):
+        # diminishing-returns exit + straggler rounds + device tail active
+        # in both solvers: trajectories must still match exactly
+        assert_window_parity(1, min_progress=2, stragglers=2)
+        assert_window_parity(4, min_progress=2, stragglers=2)
+
+    def test_dirty_rescoring_alone_is_exact(self):
+        # window off, carried scores + dirty-column rescoring on: isolates
+        # the score-maintenance half of the machinery
+        tiers = TIER_SHAPES[0]
+        spec, arrays = _encode(random_cluster(2), tiers)
+        full = _solve(spec._replace(window_k=0, dirty_k=0), arrays)
+        dirty = _solve(spec._replace(window_k=0, dirty_k=8), arrays)
+        assert np.array_equal(full[0], dirty[0])
+        assert full[1] == dirty[1]
+
+    def test_tiny_window_forces_coverage_fallback(self):
+        # a 2-wide window cannot cover a class whose demand spans many
+        # nodes: the coverage bit must trigger full-width rounds and the
+        # result must still be exact
+        spec, arrays = _encode(random_cluster(0), TIER_SHAPES[0])
+        full = _solve(spec._replace(window_k=0, dirty_k=0), arrays)
+        win = _solve(spec._replace(window_k=2, dirty_k=8), arrays)
+        assert np.array_equal(full[0], win[0])
+        assert win[3] >= 1, "expected full-sweep fallback rounds"
+
+
+@pytest.mark.slow
+class TestWindowParitySweep:
+    """The long randomized sweep (-m slow)."""
+
+    @pytest.mark.parametrize("seed", list(range(4, 24)))
+    def test_windowed_bindings_bit_identical(self, seed):
+        assert_window_parity(seed, window_k=4 + (seed % 3) * 4,
+                             dirty_k=8 + (seed % 2) * 8,
+                             min_progress=(seed % 3 == 0) and 2 or 0,
+                             stragglers=2 if seed % 3 == 0 else 0)
+
+
+def _window_session_cluster(n_groups, seed=7):
+    """A session big enough that the solver's bucket ladder turns candidate
+    windows ON (2 * window bucket <= node axis)."""
+    def populate(c):
+        rng = random.Random(seed)
+        c.add_queue(build_queue("default"))
+        for g in range(n_groups):
+            pg = f"pg{g:03d}"
+            c.add_pod_group(build_pod_group(pg, namespace="ns1", min_member=4))
+            for i in range(4):
+                c.add_pod(build_pod(
+                    "ns1", f"{pg}-p{i}", "", objects.POD_PHASE_PENDING,
+                    {"cpu": f"{rng.choice([500, 1000, 2000])}m",
+                     "memory": "1Gi"}, pg))
+        for n in range(128):
+            c.add_node(build_node(
+                f"node-{n:03d}", build_resource_list_with_pods("8", "16Gi")))
+    return populate
+
+
+def _run_rounds_session(populate):
+    cache = make_cache()
+    populate(cache)
+    ssn = open_session(
+        cache, make_tiers(["tpuscore"],
+                          ["priority", "gang"],
+                          ["predicates", "binpack", "proportion"],
+                          arguments=ROUNDS_ARGS))
+    get_action("allocate").execute(ssn)
+    prof = dict(ssn.plugins["tpuscore"].profile)
+    assert prof.get("mode") == "rounds", prof
+    close_session(ssn)
+    return cache, prof
+
+
+class TestWindowSessions:
+    def test_ladder_enables_window_and_binds_match_full_width(self, monkeypatch):
+        cache, prof = _run_rounds_session(_window_session_cluster(40))
+        assert prof.get("window_k", 0) > 0, prof
+        assert prof.get("rounds", 0) >= 1
+        # per-round profile is part of the session record
+        assert len(prof.get("round_placed", [])) == prof["rounds"]
+        assert sum(prof["round_placed"]) >= len(cache.binder.binds) - \
+            prof.get("tail_placed", 0)
+        monkeypatch.setenv("VOLCANO_TPU_WINDOW", "0")
+        cache0, prof0 = _run_rounds_session(_window_session_cluster(40))
+        assert prof0.get("window_k", 1) == 0, prof0
+        assert cache.binder.binds == cache0.binder.binds
+
+    def test_same_window_bucket_churn_does_not_compile(self):
+        """Window-size bucket transitions are jit re-keys BY DESIGN; what
+        must never retrace is count churn that stays inside every bucket —
+        including the window/dirty buckets the ladder derives."""
+        cache, prof = _run_rounds_session(_window_session_cluster(40))
+        assert prof.get("window_k", 0) > 0, prof
+        watcher = CompileWatcher.install()
+        with watcher.assert_no_compiles("same-window-bucket churned session"):
+            cache2, prof2 = _run_rounds_session(_window_session_cluster(38))
+        assert prof2.get("window_k") == prof.get("window_k")
+        assert prof2.get("dirty_k") == prof.get("dirty_k")

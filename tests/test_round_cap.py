@@ -44,9 +44,11 @@ class TestRoundCap:
         cache, _, tiers, actions, n = build_config(6, 0.4)
         prof = _run_cfg6(cache, tiers, actions)
         assert prof.get("mode") == "rounds"
-        tail_placed = prof.get("tail_placed", 0)
         capped = prof.get("round_capped_tasks", 0)
-        assert tail_placed + capped > 0, \
+        # the explicit capped flag, not tail_placed: the straggler rounds
+        # (rounds.py) can legitimately drain the whole remainder before the
+        # sequential tail sees it
+        assert prof.get("round_capped"), \
             "expected the diminishing-returns exit to fire"
         # whatever the tail left (-2) is residue for the serial pass; the
         # session outcome must still be COMPLETE either way

@@ -157,16 +157,20 @@ class BucketShape(Rule):
     """Unbucketed dynamic extents flowing into shape-defining sinks.
 
     Any ``len(...)``/``.shape`` value that reaches a pad size, a SolveSpec
-    (jit-static) field, or a kernel-input allocation without passing
-    through ``_bucket()`` re-keys the XLA program every time the live count
-    churns — the steady-state retrace that turns a ~100 ms cycle into a
-    multi-second stall (ops/solver.py pad-to-bucket contract,
-    BENCH tpu_warm_compiles=[0,0,0,0,0]). Shapes read back from
-    ``pad_encoded`` results are bucket-stable and stay clean."""
+    (jit-static) field, a ``lax.top_k`` candidate-window size, or a
+    kernel-input allocation without passing through ``_bucket()`` re-keys
+    the XLA program every time the live count churns — the steady-state
+    retrace that turns a ~100 ms cycle into a multi-second stall
+    (ops/solver.py pad-to-bucket contract, BENCH
+    tpu_warm_compiles=[0,0,0,0,0]). top_k's k is shape-defining exactly
+    like a pad size: the rounds kernel's window widths must come off the
+    solver bucket ladder (solver._window_fields), never a raw live count.
+    Shapes read back from ``pad_encoded`` results are bucket-stable and
+    stay clean."""
 
     id = "VT002"
     title = "unbucketed dynamic shape reaches a jit-static sink"
-    patterns = ("*/ops/solver.py",)
+    patterns = ("*/ops/solver.py", "*/ops/rounds.py")
 
     SANITIZERS = {"_bucket"}
     BLESSED_CALLS = {"pad_encoded"}
@@ -174,6 +178,9 @@ class BucketShape(Rule):
     SPEC_CTORS = {"SolveSpec"}
     KERNEL_ENTRIES = {"solve_allocate", "solve_rounds", "solve_rounds_packed"}
     ALLOC_FUNCS = {"zeros", "ones", "empty", "full"}
+    # window-size sinks: arg 1 (or k=) is a static shape in the compiled
+    # program — an unbucketed k is a per-churn retrace
+    TOPK_FUNCS = {"top_k", "approx_max_k", "approx_min_k"}
 
     @staticmethod
     def _numpy_aliases(tree: ast.AST) -> Set[str]:
@@ -331,6 +338,16 @@ class BucketShape(Rule):
                         f"dynamic (len/.shape-derived) value in jit-static "
                         f"SolveSpec field '{kw.arg}' — key it to the PADDED "
                         f"bucket instead"))
+        if last in self.TOPK_FUNCS:
+            k_state = arg_states[1] if len(arg_states) > 1 \
+                else kw_states.get("k", _NONE)
+            if k_state == _TAINT:
+                findings.append(Finding(
+                    self.id, path, node.lineno, node.col_offset,
+                    f"{last}() window size derives from a raw len()/.shape "
+                    f"extent — draw k from the solver bucket ladder "
+                    f"(_bucket / the jit-static spec) or every live-count "
+                    f"churn re-keys the compiled program"))
         if last in self.KERNEL_ENTRIES and arg_states \
                 and arg_states[0] == _TAINT:
             findings.append(Finding(

@@ -137,16 +137,44 @@ class SolveSpec(NamedTuple):
     # 0 disables (the parity path and small solves). Static per task
     # bucket, so it never causes steady-state retraces.
     round_min_progress: int = 0
+    # rounds-only: candidate-window width for the per-class top-k node
+    # nomination (ops/rounds.py). 0 = full-width sweeps. MUST come off the
+    # solver bucket ladder (solver._window_fields -> _bucket): the value is
+    # jit-static, so an unbucketed k re-keys the compiled program on every
+    # live-count churn (vclint VT002 covers the top_k sink).
+    window_k: int = 0
+    # rounds-only: dirty-column rescoring gather width. When fewer than this
+    # many node columns changed since the last round, the carried score
+    # matrix is patched by a [K, dirty_k] gather-recompute instead of the
+    # full chunked [K, N] sweep. 0 = always full refresh. Bucketed like
+    # window_k.
+    dirty_k: int = 0
+    # rounds-only: extra batched rounds over the diminishing-returns
+    # stragglers before the sequential tail pass — with candidate windows a
+    # narrow round is cheap, so bulk-placing most of the remainder beats
+    # dumping it on the one-task-per-step tail. 0 = exit straight to the
+    # tail as before.
+    straggler_rounds: int = 0
 
 
-def fused_scores(spec: SolveSpec, enc, used, req, nz_cpu, nz_mem, sig):
+def fused_scores(spec: SolveSpec, enc, used, req, nz_cpu, nz_mem, sig,
+                 alloc=None, aff=None):
     """Fused binpack + nodeorder node scores (binpack.go:201-261,
     nodeorder.go:161-200), broadcast over any leading task dims.
 
     used/alloc: [N, R]; req: [..., R]; nz_cpu/nz_mem: [...]; sig: [...] int.
     Returns [..., N] float scores.
+
+    `alloc`/`aff` override the enc-wide node_alloc / affinity_score matrices
+    with column-gathered slices ([M, R] / [S, M]) so the rounds solver's
+    dirty-column rescoring can recompute scores for just the touched node
+    columns; every op here is column-separable, so a gathered recompute is
+    bit-identical to gathering a full recompute.
     """
-    alloc = enc["node_alloc"]  # [N, R] allocatable
+    if alloc is None:
+        alloc = enc["node_alloc"]  # [N, R] allocatable
+    if aff is None:
+        aff = enc["affinity_score"]
     lead = req.shape[:-1]
     score = jnp.zeros(lead + (used.shape[0],), used.dtype)
 
@@ -171,7 +199,7 @@ def fused_scores(spec: SolveSpec, enc, used, req, nz_cpu, nz_mem, sig):
         )
         score = score + least * enc["least_req_weight"] + balanced * enc["balanced_weight"]
         # static preferred node-affinity score, per signature
-        score = score + enc["affinity_score"][sig] * enc["node_affinity_weight"]
+        score = score + aff[sig] * enc["node_affinity_weight"]
 
     if spec.use_binpack:
         # per-dim weights zeroed where the task requests nothing

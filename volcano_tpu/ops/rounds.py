@@ -11,21 +11,33 @@ Round (all on device, one jitted while_loop):
    order); tasks in overused queues sit the round out (proportion.go:201).
 2. (K x N) fused feasibility ∧ epsilon-fit ∧ pod-count masks and
    binpack+nodeorder scores over task equivalence CLASSES (K ~ #templates
-   << T); each class's feasible nodes are ordered by descending score and
-   the class's i-th active task takes the node where i falls in cumulative
-   estimated capacity — rotated within equal-score groups for spreading
-   policies, sequential (packing) when binpack is on, with per-class
-   demand-share apportioning so contending classes don't all over-claim
-   the same nodes.
+   << T), carried ACROSS rounds in the while_loop state with dirty-column
+   rescoring: a round commits onto a small node set, so the next round
+   recomputes only the touched columns (a [K, dirty_k] gather-scatter)
+   instead of the full chunked sweep. Node CANDIDATES come from a bounded
+   top-k window per class (`lax.top_k` — a bit-identical prefix of the
+   stable argsort order, ties included); each class's feasible nodes are
+   ordered by descending score and the class's i-th active task takes the
+   node where i falls in cumulative estimated capacity — rotated within
+   equal-score groups for spreading policies, sequential (packing) when
+   binpack is on, with per-class demand-share apportioning. A per-class
+   COVERAGE bit proves the windowed answer equals the full-width one
+   (window holds the whole feasible set, or every task's slot and final
+   position land strictly before the window's possibly-truncated last
+   equal-score group); any uncovered class gets a full-width nomination
+   that round, so placements are bit-identical to full-width sweeps.
 3. conflict resolution: sort tasks by (chosen node, task rank); per-node
    *prefix acceptance* — the longest priority-prefix whose cumulative request
    fits idle (cumsum ≤ idle + eps reproduces the serial per-step epsilon
    exactly) and pod slots; capacity estimates in step 2 are advisory only.
-4. scatter-commit: idle/used/pod-count, job/queue/namespace allocation.
+4. scatter-commit: idle/used/pod-count, job/queue/namespace allocation; the
+   touched node columns become the next round's dirty set.
 Rounds repeat while any task lands. Then a gang-rollback pass retires the
 worst-ranked job still short of min_available (statement.go Discard
 semantics) and rounds resume on the freed capacity — a fixpoint loop that
-terminates because each rollback retires exactly one job.
+terminates because each rollback retires exactly one job (rollback marks
+the freed columns dirty; a large rollback overflows the dirty budget and
+triggers a full rescore, never a stale score).
 
 Documented divergences from the serial oracle (and hence from parity mode):
 scores are computed against round-start state (bulk-synchronous), fair-share
@@ -34,10 +46,11 @@ when a rollback drops them below deserved, weighted-DRF NAMESPACE ordering
 is not applied to the job rank (_job_rank keys on tie-rank/priority/gang/
 drf-share only; ns_alloc is tracked in state but does not reorder jobs —
 namespace fairness under contention is round-granular at best), the
-adaptive node-sampling window does not apply (every task sees every node —
-strictly better placements than the reference's sampled serial loop), and
-per-cycle placement count may fall short of the serial oracle by a bounded
-margin: under tight selector/taint contention the bulk rounds can consume
+reference's adaptive node-sampling window does not apply: the candidate
+window here is a PRUNING device with an exactness fallback, not a sampling
+device — every task still sees, in effect, every node, and per-cycle
+placement count may fall short of the serial oracle by a bounded margin:
+under tight selector/taint contention the bulk rounds can consume
 a constrained node pool with a different task mix than the serial visit
 order, stranding a straggler (retried next cycle). Fuzz-bounded at
 max(2, serial//50) tasks — see tests/test_rounds_scale.py and
@@ -46,7 +59,8 @@ docs/DESIGN.md §3.
 Invariants preserved (asserted by tests/test_rounds.py): every placement is
 feasible per the predicate mask and epsilon arithmetic, no node exceeds idle
 or pod capacity, gangs are all-or-nothing, queue `deserved` caps are
-respected through the overused gate.
+respected through the overused gate. Window-vs-full-width bit-identity is
+fuzz-pinned by tests/test_candidate_window.py.
 """
 
 from __future__ import annotations
@@ -66,6 +80,12 @@ from volcano_tpu.ops.kernels import (
 
 CHUNK = 128
 
+# per-round profile exported through the packed single-fetch result:
+# placed-per-round histogram slots plus the scalar tail (round-count limbs,
+# tail_placed, full-sweep round count, capped flag)
+PROF_SLOTS = 64
+PROF_TAIL = 5 + PROF_SLOTS
+
 
 def _job_rank(spec: SolveSpec, enc, job_placed, job_alloc):
     """[J] dense rank from the tiered job-order keys (low = first)."""
@@ -84,242 +104,276 @@ def _job_rank(spec: SolveSpec, enc, job_placed, job_alloc):
     return jnp.zeros(j, jnp.int32).at[order].set(jnp.arange(j, dtype=jnp.int32))
 
 
-def _choices(spec: SolveSpec, enc, idle, used, cnt, active, excl_occ=None,
-             compact=False):
-    """Per-task node choice via task equivalence classes.
-
-    Tasks stamped from one template share (req, initreq, signature,
-    has_pod) — encoder.task_cls — and therefore produce IDENTICAL masked
-    score rows, so the sweep is (K x N) over classes with K ~ #templates
-    << T. Each class's feasible nodes are ordered by descending score
-    (stable: ascending node index on ties) with a per-node capacity
-    ESTIMATE; the class's i-th active task takes the node where i falls in
-    cumulative capacity — so one round can saturate many nodes, not just
-    each class's argmax. Within equal-score groups the assignment rotates
-    (spreading policies' serial behavior on tied nodes) unless binpack is
-    enabled (packing fills node by node). Estimates are advisory:
-    _resolve's exact prefix acceptance enforces real feasibility, and the
-    optimistic tail retries next round. A task can therefore land on a
-    lower-scoring node than its class argmax within a round (documented
-    round-granularity divergence, see module doc).
-
-    Returns (choice [T] int32, -1 when nothing feasible/inactive)."""
-    k_total = enc["cls_req"].shape[0]
-    n_total = idle.shape[0]
+def _score_block(spec: SolveSpec, enc, req, initreq, sig, nz_cpu, nz_mem,
+                 has_pod, exl, idle_c, used_c, cnt_c, occ_c, sigmask_c,
+                 nmax_c, alloc_c, aff_c):
+    """Masked fused feasibility+score block for a batch of class ROWS over a
+    batch of node COLUMNS (the full axis, or a dirty-column gather): -inf
+    where the class cannot place on the node, the fused binpack+nodeorder
+    score elsewhere. Every op is column-separable (elementwise per node, or
+    a reduction over the static R axis), so recomputing a gathered column
+    is bit-identical to gathering a full recompute — the property that lets
+    the carried score matrix be patched instead of rebuilt."""
     eps = enc["eps"]
     is_scalar = enc["is_scalar"]
-    neg = jnp.array(-jnp.inf, idle.dtype)
-    task_cls = enc["task_cls"]
-    t_cap = task_cls.shape[0] + 1  # capacity clamp: ranks never reach it
+    neg = jnp.array(-jnp.inf, idle_c.dtype)
+    # epsilon fit of init requests against idle (resource_info.go:267)
+    le = initreq[:, None, :] < idle_c[None, :, :] + eps[None, None, :]
+    skip = is_scalar[None, None, :] & (initreq[:, None, :] <= MIN_MILLI_SCALAR)
+    mask = jnp.all(le | skip, axis=-1) & sigmask_c[sig]       # [rows, M]
+    if spec.check_pod_count:
+        mask = mask & ((cnt_c[None, :] < nmax_c[None, :]) | ~has_pod[:, None])
+    if spec.use_exclusion:
+        # exclusion-group classes: nodes already holding a group member
+        # (resident at encode, or committed in an earlier round) are
+        # infeasible for the whole class
+        occ = occ_c[jnp.maximum(exl, 0)]                      # [rows, M]
+        mask = mask & ~(occ & (exl >= 0)[:, None])
+    score = fused_scores(spec, enc, used_c, req, nz_cpu, nz_mem, sig,
+                         alloc=alloc_c, aff=aff_c)
+    return jnp.where(mask, score, neg)
 
-    # a class is live iff any of its tasks is still active; dead-class
-    # chunks skip the (chunk x N) sweep (late rounds: most classes placed)
-    cls_live = jnp.zeros(k_total, bool).at[task_cls].max(active)
-    # per-class active demand, for the binpack capacity apportioning: with
-    # a packing policy every class walks the SAME node order, so each must
-    # claim only its demand share of a node's estimated capacity or the
-    # round over-commits the first nodes K-fold and convergence crawls
-    cls_demand = jnp.zeros(k_total, jnp.int32).at[task_cls].add(
-        active.astype(jnp.int32))
-    cls_frac = cls_demand.astype(idle.dtype) / jnp.maximum(
-        jnp.sum(cls_demand), 1).astype(idle.dtype)
 
-    chunk = min(CHUNK, k_total)  # both powers of two (solver buckets)
+def _refresh_scores(spec: SolveSpec, enc, idle, used, cnt, excl_occ):
+    """Full-width recompute of the carried [K, N] masked score matrix,
+    chunked over class rows to bound the [rows, N, R] fit/score
+    temporaries. Rows are computed for EVERY class, live or not — overused
+    queues can re-enter after a rollback and revive a class, and a revived
+    class must find current scores, not a stale skip."""
+    k_total = enc["cls_req"].shape[0]
+    n_total = idle.shape[0]
+    chunk = min(CHUNK, k_total)
     n_chunks = k_total // chunk
-
-    def sweep_rows(req, initreq, sig, nz_cpu, nz_mem, has_pod, exl, frac,
-                   live_rows):
-        """The (rows x N) feasibility/score/capacity sweep over a batch of
-        class rows — either a contiguous chunk or a gathered compaction of
-        the live classes. Dead rows (live_rows False) come out all-masked
-        (n_feas 0), so their tasks never produce a choice."""
-        rows = req.shape[0]
-        # epsilon fit of init requests against idle (resource_info.go:267)
-        le = initreq[:, None, :] < idle[None, :, :] + eps[None, None, :]
-        skip = is_scalar[None, None, :] & (initreq[:, None, :] <= MIN_MILLI_SCALAR)
-        fit = jnp.all(le | skip, axis=-1)                     # [C, N]
-        mask = fit & enc["sig_mask"][sig] & live_rows[:, None]
-        if spec.check_pod_count:
-            mask = mask & ((cnt[None, :] < enc["node_max_tasks"][None, :])
-                           | ~has_pod[:, None])
-        if spec.use_exclusion:
-            # exclusion-group classes: nodes already holding a group
-            # member (resident at encode, or committed in an earlier
-            # round) are infeasible for the whole class
-            occ = excl_occ[jnp.maximum(exl, 0)]              # [C, N]
-            mask = mask & ~(occ & (exl >= 0)[:, None])
-
-        score = fused_scores(spec, enc, used, req, nz_cpu, nz_mem, sig)
-        masked = jnp.where(mask, score, neg)
-        # capacity-aware spreading: rank the class's feasible nodes by
-        # descending score (stable => ascending node index on ties, the
-        # serial tie-break), estimate how many of THIS class each node
-        # can hold, and hand the class's i-th task a node where i falls
-        # in cumulative capacity — INTERLEAVED across equal-score
-        # groups. Why both mechanisms: score-concentrating policies
-        # (binpack) would otherwise send every task of a class to the
-        # one best node and the bulk-synchronous round fills a single
-        # node's prefix (measured: 89 rounds at cfg2), while spreading
-        # policies (least-requested) tie whole groups of nodes whose
-        # serial behavior is round-robin; the capacity walk handles the
-        # former, the within-group rotation the latter. _resolve's
-        # exact prefix acceptance cleans up the optimistic tail.
-        order = jnp.argsort(-masked, axis=-1, stable=True)  # [C, N]
-        # per-(class, node) capacity estimate from per-dim idle/req
-        # (advisory only — real feasibility stays with _resolve)
-        safe_req = jnp.maximum(req, eps[None, :])
-        cap_dim = idle[None, :, :] / safe_req[:, None, :]   # [C, N, R]
-        cap = jnp.min(
-            jnp.where((req > 0)[:, None, :], cap_dim, jnp.inf), axis=-1)
-        big = jnp.asarray(float(t_cap), idle.dtype)
-        cap = jnp.minimum(jnp.where(jnp.isinf(cap), big, cap), big)
-        if spec.use_binpack:
-            cap = cap * frac[:, None]
-        if spec.use_exclusion:
-            # at most one group member per node, ever
-            cap = jnp.where((exl >= 0)[:, None],
-                            jnp.minimum(cap, 1.0), cap)
-        if spec.check_pod_count:
-            pod_room = (enc["node_max_tasks"] - cnt)[None, :].astype(cap.dtype)
-            cap = jnp.where(has_pod[:, None],
-                            jnp.minimum(cap, pod_room), cap)
-        cap = jnp.where(mask, jnp.floor(cap), 0.0)
-        cap = jnp.maximum(cap, jnp.where(mask, 1.0, 0.0))  # >=1 if feasible
-        cap_i = cap.astype(jnp.int32)
-        # SATURATING prefix sum at t_cap (> any rank): a plain int32
-        # cumsum can wrap at N*(T+1); saturating add of non-negatives
-        # is associative, so the scan stays exact and monotone with
-        # every partial <= 2*t_cap
-        ccap = lax.associative_scan(
-            lambda a, b: jnp.minimum(a + b, jnp.int32(t_cap)),
-            jnp.take_along_axis(cap_i, order, axis=-1), axis=1)  # [C, N]
-
-        # equal-score groups along the ordered axis (for the rotation)
-        score_ord = jnp.take_along_axis(masked, order, axis=-1)
-        pos = jnp.broadcast_to(
-            jnp.arange(n_total, dtype=jnp.int32)[None, :],
-            (rows, n_total))
-        is_start = jnp.concatenate(
-            [jnp.ones((rows, 1), bool),
-             score_ord[:, 1:] != score_ord[:, :-1]], axis=1)
-        g_start = lax.cummax(jnp.where(is_start, pos, 0), axis=1)
-        starts = jnp.where(is_start, pos, jnp.int32(n_total))
-        # next group start AFTER j: suffix-min of starts, shifted left
-        sfx = jnp.flip(lax.cummin(jnp.flip(starts, axis=1), axis=1), axis=1)
-        g_end = jnp.concatenate(
-            [sfx[:, 1:], jnp.full((rows, 1), n_total, jnp.int32)], axis=1)
-        g_size = g_end - g_start
-        ccap_before = jnp.where(
-            g_start > 0,
-            jnp.take_along_axis(ccap, jnp.maximum(g_start - 1, 0), axis=1),
-            0)
-        n_feas = jnp.sum(mask, axis=-1).astype(jnp.int32)
-        return (order.astype(jnp.int32), ccap, g_start, g_size,
-                ccap_before, n_feas)
 
     def one_chunk(ci):
         sl = ci * chunk
-        live = lax.dynamic_slice_in_dim(cls_live, sl, chunk)
 
-        def sweep(_):
-            return sweep_rows(
-                lax.dynamic_slice_in_dim(enc["cls_req"], sl, chunk),
-                lax.dynamic_slice_in_dim(enc["cls_initreq"], sl, chunk),
-                lax.dynamic_slice_in_dim(enc["cls_sig"], sl, chunk),
-                lax.dynamic_slice_in_dim(enc["cls_nz_cpu"], sl, chunk),
-                lax.dynamic_slice_in_dim(enc["cls_nz_mem"], sl, chunk),
-                lax.dynamic_slice_in_dim(enc["cls_has_pod"], sl, chunk),
-                lax.dynamic_slice_in_dim(enc["cls_excl"], sl, chunk)
-                if spec.use_exclusion else None,
-                lax.dynamic_slice_in_dim(cls_frac, sl, chunk)
-                if spec.use_binpack else None,
-                live)
+        def sli(name):
+            return lax.dynamic_slice_in_dim(enc[name], sl, chunk)
 
-        zero_i = lambda: jnp.zeros((chunk, n_total), jnp.int32)  # noqa: E731
-        return lax.cond(
-            live.any(), sweep,
-            lambda _: (zero_i(), zero_i(), zero_i(),
-                       jnp.ones((chunk, n_total), jnp.int32), zero_i(),
-                       jnp.zeros((chunk,), jnp.int32)), None)
+        return _score_block(
+            spec, enc, sli("cls_req"), sli("cls_initreq"), sli("cls_sig"),
+            sli("cls_nz_cpu"), sli("cls_nz_mem"), sli("cls_has_pod"),
+            sli("cls_excl") if spec.use_exclusion else None,
+            idle, used, cnt, excl_occ, enc["sig_mask"],
+            enc["node_max_tasks"], enc["node_alloc"], enc["affinity_score"])
 
-    def chunked_sweep(_):
+    if n_chunks > 1:
+        return lax.map(one_chunk, jnp.arange(n_chunks)).reshape(
+            k_total, n_total)
+    return one_chunk(0)
+
+
+def _rescore_dirty(spec: SolveSpec, enc, idle, used, cnt, excl_occ,
+                   scores, dirty):
+    """Dirty-column rescoring: scatter-recompute the carried score matrix
+    for the <= dirty_k node columns the previous round touched
+    (commit/rollback writes to idle/used/cnt/occupancy). Gathers the
+    column state, recomputes the [K, dirty_k] block with the same
+    column-separable kernel the full sweep uses, and scatters it back.
+    Padding slots of the nonzero gather alias column 0 — they rewrite
+    identical values, so duplicate scatter writes are benign."""
+    cols = jnp.nonzero(dirty, size=spec.dirty_k, fill_value=0)[0].astype(
+        jnp.int32)
+    block = _score_block(
+        spec, enc, enc["cls_req"], enc["cls_initreq"], enc["cls_sig"],
+        enc["cls_nz_cpu"], enc["cls_nz_mem"], enc["cls_has_pod"],
+        enc["cls_excl"] if spec.use_exclusion else None,
+        idle[cols], used[cols], cnt[cols],
+        excl_occ[:, cols] if spec.use_exclusion else None,
+        enc["sig_mask"][:, cols], enc["node_max_tasks"][cols],
+        enc["node_alloc"][cols], enc["affinity_score"][:, cols])
+    return scores.at[:, cols].set(block)
+
+
+def _cap_walk(spec: SolveSpec, enc, order, score_ord, req, exl, has_pod,
+              frac, idle, cnt, t_cap):
+    """Capacity estimates and equal-score group structure along an ORDERED
+    candidate axis — either the full stable-argsort order or its lax.top_k
+    prefix window (top_k breaks ties toward lower indices exactly like the
+    stable sort, so the window IS a prefix, ties included).
+
+    order/score_ord: [rows, W]. The [rows, W, R] capacity gather replaces
+    the old full-axis [C, N, R] materialization: capacity is only computed
+    for nominated nodes. Returns (ccap, g_start, g_size, ccap_before), all
+    [rows, W]; per-(class, node) arithmetic is identical to the full-width
+    walk, so windowed values are exact prefixes of it.
+
+    Why both mechanisms (capacity walk + tie rotation): score-concentrating
+    policies (binpack) would otherwise send every task of a class to the
+    one best node and the bulk-synchronous round fills a single node's
+    prefix (measured: 89 rounds at cfg2), while spreading policies
+    (least-requested) tie whole groups of nodes whose serial behavior is
+    round-robin; the capacity walk handles the former, the within-group
+    rotation the latter. _resolve's exact prefix acceptance cleans up the
+    optimistic tail."""
+    rows, width = order.shape
+    feas = score_ord > jnp.array(-jnp.inf, score_ord.dtype)
+    idle_w = idle[order]                                  # [rows, W, R]
+    eps = enc["eps"]
+    # per-(class, node) capacity estimate from per-dim idle/req
+    # (advisory only — real feasibility stays with _resolve)
+    safe_req = jnp.maximum(req, eps[None, :])
+    cap_dim = idle_w / safe_req[:, None, :]               # [rows, W, R]
+    cap = jnp.min(
+        jnp.where((req > 0)[:, None, :], cap_dim, jnp.inf), axis=-1)
+    big = jnp.asarray(float(t_cap), idle.dtype)
+    cap = jnp.minimum(jnp.where(jnp.isinf(cap), big, cap), big)
+    if spec.use_binpack:
+        cap = cap * frac[:, None]
+    if spec.use_exclusion:
+        # at most one group member per node, ever
+        cap = jnp.where((exl >= 0)[:, None], jnp.minimum(cap, 1.0), cap)
+    if spec.check_pod_count:
+        pod_room = (enc["node_max_tasks"] - cnt)[order].astype(cap.dtype)
+        cap = jnp.where(has_pod[:, None], jnp.minimum(cap, pod_room), cap)
+    cap = jnp.where(feas, jnp.floor(cap), 0.0)
+    cap = jnp.maximum(cap, jnp.where(feas, 1.0, 0.0))  # >=1 if feasible
+    cap_i = cap.astype(jnp.int32)
+    # SATURATING prefix sum at t_cap (> any rank): a plain int32 cumsum can
+    # wrap at N*(T+1); saturating add of non-negatives is associative, so
+    # the scan stays exact and monotone with every partial <= 2*t_cap
+    ccap = lax.associative_scan(
+        lambda a, b: jnp.minimum(a + b, jnp.int32(t_cap)), cap_i, axis=1)
+
+    # equal-score groups along the ordered axis (for the rotation)
+    pos = jnp.broadcast_to(
+        jnp.arange(width, dtype=jnp.int32)[None, :], (rows, width))
+    is_start = jnp.concatenate(
+        [jnp.ones((rows, 1), bool),
+         score_ord[:, 1:] != score_ord[:, :-1]], axis=1)
+    g_start = lax.cummax(jnp.where(is_start, pos, 0), axis=1)
+    starts = jnp.where(is_start, pos, jnp.int32(width))
+    # next group start AFTER j: suffix-min of starts, shifted left
+    sfx = jnp.flip(lax.cummin(jnp.flip(starts, axis=1), axis=1), axis=1)
+    g_end = jnp.concatenate(
+        [sfx[:, 1:], jnp.full((rows, 1), width, jnp.int32)], axis=1)
+    g_size = g_end - g_start
+    ccap_before = jnp.where(
+        g_start > 0,
+        jnp.take_along_axis(ccap, jnp.maximum(g_start - 1, 0), axis=1), 0)
+    return ccap, g_start, g_size, ccap_before
+
+
+def _nominate_full(spec: SolveSpec, enc, scores, idle, cnt, cls_frac, t_cap):
+    """Full-width nomination: stable argsort over all N columns plus the
+    capacity walk, chunked over class rows (bounds the [rows, N, R]
+    gather). Runs when candidate windows are disabled, and as the
+    exactness fallback on rounds where some class's window lacks
+    coverage."""
+    k_total, n_total = scores.shape
+    chunk = min(CHUNK, k_total)
+    n_chunks = k_total // chunk
+
+    def one_chunk(ci):
+        sl = ci * chunk
+
+        def sli(name):
+            return lax.dynamic_slice_in_dim(enc[name], sl, chunk)
+
+        sc = lax.dynamic_slice_in_dim(scores, sl, chunk)
+        order = jnp.argsort(-sc, axis=-1, stable=True).astype(jnp.int32)
+        score_ord = jnp.take_along_axis(sc, order, axis=-1)
+        ccap, g_start, g_size, ccap_before = _cap_walk(
+            spec, enc, order, score_ord, sli("cls_req"),
+            sli("cls_excl") if spec.use_exclusion else None,
+            sli("cls_has_pod"),
+            lax.dynamic_slice_in_dim(cls_frac, sl, chunk)
+            if spec.use_binpack else None,
+            idle, cnt, t_cap)
+        return order, ccap, g_start, g_size, ccap_before
+
+    if n_chunks > 1:
         outs = lax.map(one_chunk, jnp.arange(n_chunks))
-        return tuple(
-            x.reshape(k_total, n_total) if x.ndim == 3 else
-            x.reshape(k_total)
-            for x in outs)
+        return tuple(x.reshape(k_total, n_total) for x in outs)
+    return one_chunk(0)
 
-    if compact and n_chunks > 1:
-        # late rounds leave a few live classes SCATTERED across chunks
-        # (exclusion stragglers early, plain leftovers late) — every chunk
-        # then pays its full (chunk x N) sweep for a handful of rows. The
-        # compact phase (solve_rounds runs it once the live count fits one
-        # chunk — monotone: classes never revive) gathers the live rows,
-        # runs a single sweep, and scatters the results back: the fixed
-        # per-round cost drops by ~n_chunks for the convergence tail.
-        # Taking exactly `chunk` rows is safe even if more are live (the
-        # ungathered classes come out all-masked and simply retry).
-        sel = jnp.argsort(~cls_live, stable=True)[:chunk]  # live first
-        o, cc, gs, gz, cb, nf = sweep_rows(
-            enc["cls_req"][sel], enc["cls_initreq"][sel],
-            enc["cls_sig"][sel], enc["cls_nz_cpu"][sel],
-            enc["cls_nz_mem"][sel], enc["cls_has_pod"][sel],
-            enc["cls_excl"][sel] if spec.use_exclusion else None,
-            cls_frac[sel] if spec.use_binpack else None,
-            cls_live[sel])
-        z = jnp.zeros((k_total, n_total), jnp.int32)
-        order, ccap, g_start, g_size, ccap_before, n_feas = (
-            z.at[sel].set(o), z.at[sel].set(cc), z.at[sel].set(gs),
-            jnp.ones((k_total, n_total), jnp.int32).at[sel].set(gz),
-            z.at[sel].set(cb),
-            jnp.zeros(k_total, jnp.int32).at[sel].set(nf))
-    else:
-        order, ccap, g_start, g_size, ccap_before, n_feas = chunked_sweep(None)
 
+def _excl_grank(enc, cls_live):
+    """Rank of each class among its exclusion group's LIVE classes, lower
+    class index first. Same-group classes (e.g. one anti-affinity
+    deployment whose members differ in requests and are therefore
+    SINGLETON classes) score near-identically and would all aim at the
+    same argmax — one winner per (group, node) per round makes convergence
+    crawl at ~group_size rounds (measured: 33 rounds on the affinity
+    bench). Offsetting each class by this rank spreads the group over
+    distinct ordered positions within ONE round; the winner scatter +
+    occupancy mask still enforce mutual exclusion exactly. One stable
+    argsort (group-major, index-ascending) + segmented prefix count —
+    O(K log K), not a [K, K] compare."""
+    exl_all = enc["cls_excl"]
+    perm = jnp.argsort(exl_all, stable=True)
+    sorted_gid = exl_all[perm]
+    sorted_live = cls_live[perm].astype(jnp.int32)
+    prefix = jnp.cumsum(sorted_live) - sorted_live  # live strictly before
+    seg_start = jnp.concatenate(
+        [jnp.ones(1, bool), sorted_gid[1:] != sorted_gid[:-1]])
+    # prefix is non-decreasing, so cummax propagates each segment's
+    # starting prefix down the segment
+    seg_base = lax.cummax(jnp.where(seg_start, prefix, 0))
+    return jnp.zeros(exl_all.shape[0], jnp.int32).at[perm].set(
+        (prefix - seg_base).astype(jnp.int32))
+
+
+def _rank_in_class(task_cls, active):
+    """Rank of each ACTIVE task within its class, in flat order: sort by
+    (class, inactive-last, flat index), take the position inside the
+    (class, active) segment — O(T log T), no T x K blowup."""
     t_total = task_cls.shape[0]
-    # rank of each ACTIVE task within its class, in flat order: sort by
-    # (class, inactive-last, flat index), take the position inside the
-    # (class, active) segment — O(T log T), no T x K blowup
     idxs = jnp.arange(t_total, dtype=jnp.int32)
     ordix = jnp.lexsort((idxs, ~active, task_cls))
     sorted_cls = task_cls[ordix]
     sorted_act = active[ordix]
     seg_start = jnp.concatenate(
         [jnp.ones(1, bool),
-         (sorted_cls[1:] != sorted_cls[:-1]) | (sorted_act[1:] != sorted_act[:-1])])
+         (sorted_cls[1:] != sorted_cls[:-1])
+         | (sorted_act[1:] != sorted_act[:-1])])
     start_idx = lax.cummax(jnp.where(seg_start, idxs, 0))
-    rank = jnp.zeros(t_total, jnp.int32).at[ordix].set(idxs - start_idx)
+    return jnp.zeros(t_total, jnp.int32).at[ordix].set(idxs - start_idx)
 
-    # slot = first ordered position whose cumulative capacity exceeds the
-    # task's rank — a vectorized binary search over each task's class row:
-    # O(T log N) gathers instead of materializing a [T, N] comparison
+
+def _select(spec: SolveSpec, enc, task_cls, active, rank, n_feas, grank,
+            order, ccap, g_start, g_size, ccap_before):
+    """Per-task node choice from an ordered per-class candidate axis of
+    static width W (the full node axis, or a top-k window whose walk
+    arrays are exact prefixes of the full ones).
+
+    slot = first ordered position whose cumulative capacity exceeds the
+    task's rank — a vectorized binary search over each task's class row:
+    O(T log W) gathers instead of materializing a [T, W] comparison.
+    Within equal-score groups the assignment rotates (spreading policies'
+    serial behavior on tied nodes) unless binpack is enabled (packing
+    fills node by node; serial binpack breaks round-start ties TOWARD the
+    node it just filled). Exclusion classes spread by their group-live
+    rank. Returns (choice, cons_choice, slot, final): slot is the raw
+    capacity-walk position (un-clipped; == W when the walk ran past the
+    axis), final the post-rotation/post-spread position the choice was
+    gathered from — the windowed caller's coverage predicate runs on
+    both. cons_choice is each task's class-best feasible node (the
+    pre-capacity-walk argmax semantics), used by the stalemate-breaker
+    round."""
+    width = order.shape[1]
+    tk = task_cls
+    t_total = tk.shape[0]
     lo = jnp.zeros(t_total, jnp.int32)
-    hi = jnp.full(t_total, n_total, jnp.int32)
-    # interval [0, n_total] holds n_total+1 answers => ceil(log2(n+1)) =
-    # n_total.bit_length() halvings (one fewer under-shoots slots when the
-    # node count is a power of two)
-    for _ in range(max(1, n_total.bit_length())):
+    hi = jnp.full(t_total, width, jnp.int32)
+    # interval [0, W] holds W+1 answers => W.bit_length() halvings cover it
+    for _ in range(max(1, int(width).bit_length())):
         mid = (lo + hi) // 2
-        go_right = ccap[task_cls, jnp.minimum(mid, n_total - 1)] <= rank
+        go_right = ccap[tk, jnp.minimum(mid, width - 1)] <= rank
         lo = jnp.where(go_right, mid + 1, lo)
         hi = jnp.where(go_right, hi, mid)
     slot = lo
     # tasks whose rank exceeds total estimated capacity retry next round on
     # the refreshed state; clamp keeps the gathers in bounds
-    overflow = slot >= n_feas[task_cls]
-    slot = jnp.clip(slot, 0, n_total - 1)
-    tk = task_cls
+    overflow = slot >= n_feas[tk]
+    slot_c = jnp.clip(slot, 0, width - 1)
     if spec.use_binpack and not spec.use_exclusion:
-        # packing policy: serial binpack breaks round-start ties TOWARD the
-        # node it just filled (fill one node, then the next); the
-        # sequential capacity walk reproduces that — no rotation
-        final = slot
+        final = slot_c
     else:
-        # spreading policies (least-requested/balanced): serial behavior on
-        # tied nodes is round-robin; rotate within the equal-score group
-        gs = g_start[tk, slot]
-        gz = jnp.maximum(g_size[tk, slot], 1)
-        local = rank - ccap_before[tk, slot]
+        gs = g_start[tk, slot_c]
+        gz = jnp.maximum(g_size[tk, slot_c], 1)
+        local = rank - ccap_before[tk, slot_c]
         rotated = gs + (jnp.maximum(local, 0) % gz)
         if spec.use_binpack:
             # exclusion classes are capped at one member per node, so the
@@ -327,47 +381,18 @@ def _choices(spec: SolveSpec, enc, idle, used, cnt, active, excl_occ=None,
             # and bounce all but one per round (convergence crawl); rotate
             # THEM within tied groups, keep true packing for the rest
             is_excl = enc["cls_excl"][tk] >= 0
-            final = jnp.where(is_excl, rotated, slot)
+            final = jnp.where(is_excl, rotated, slot_c)
         else:
             final = rotated
     if spec.use_exclusion:
-        # same-group classes (e.g. one anti-affinity deployment whose
-        # members differ in requests and are therefore SINGLETON classes)
-        # score near-identically and would all aim at the same argmax —
-        # one winner per (group, node) per round makes convergence crawl
-        # at ~group_size rounds (measured: 33 rounds on the affinity
-        # bench). Offsetting each class by its rank among its group's LIVE
-        # classes spreads the group over distinct ordered positions within
-        # ONE round; the winner scatter + occupancy mask still enforce
-        # mutual exclusion exactly.
-        # rank of each class among its group's LIVE classes, lower class
-        # index first: one stable argsort (group-major, index-ascending)
-        # + segmented prefix count — O(K log K), not a [K, K] compare
-        exl_all = enc["cls_excl"]
-        perm = jnp.argsort(exl_all, stable=True)
-        sorted_gid = exl_all[perm]
-        sorted_live = cls_live[perm].astype(jnp.int32)
-        prefix = jnp.cumsum(sorted_live) - sorted_live  # live strictly before
-        seg_start = jnp.concatenate(
-            [jnp.ones(1, bool), sorted_gid[1:] != sorted_gid[:-1]])
-        # prefix is non-decreasing, so cummax propagates each segment's
-        # starting prefix down the segment
-        seg_base = lax.cummax(jnp.where(seg_start, prefix, 0))
-        grank = jnp.zeros(exl_all.shape[0], jnp.int32).at[perm].set(
-            (prefix - seg_base).astype(jnp.int32))
         is_exg = enc["cls_excl"][tk] >= 0
         spread = jnp.clip(final + grank[tk], 0,
                           jnp.maximum(n_feas[tk] - 1, 0))
         final = jnp.where(is_exg, spread, final)
-    choice = order[tk, final]
+    choice = order[tk, jnp.clip(final, 0, width - 1)]
     feasible = (n_feas[tk] > 0) & ~overflow & active
-    # conservative retry choice: each task's class-best feasible node (the
-    # pre-capacity-walk argmax semantics). Used by the stalemate-breaker
-    # round: the deterministic capacity walk can map a task to the same
-    # _resolve-rejected node every round; the best-node choice guarantees
-    # progress whenever anything feasible fits alone.
     cons_choice = jnp.where((n_feas[tk] > 0) & active, order[tk, 0], -1)
-    return jnp.where(feasible, choice, -1), cons_choice
+    return jnp.where(feasible, choice, -1), cons_choice, slot, final
 
 
 def _seg_limbs(req_s, start_idx):
@@ -533,20 +558,26 @@ def solve_rounds_packed(spec: SolveSpec, layout, bufs):
     packs them into flat per-group buffers host-side (solver._pack, with a
     device cache for unchanged groups) and this entry unpacks with static
     slices — free under XLA fusion. The result is ONE array — assign plus
-    the round counter packed into trailing limbs — so the host pays exactly
-    one D2H round trip; int16 when the node count allows (halves the
-    downlink; assign values are node indices or -1)."""
+    a PROF_TAIL-long profile tail (round-counter limbs, tail_placed,
+    full-sweep round count, capped flag, the placed-per-round histogram) —
+    so the host pays exactly one D2H round trip; int16 when the node count
+    allows (halves the downlink; assign values are node indices or -1)."""
     enc = {
         name: lax.slice_in_dim(bufs[key], off, off + size).reshape(shape)
         for name, key, off, size, shape in layout
     }
-    assign, n_rounds, tail_placed = solve_rounds.__wrapped__(spec, enc)
+    (assign, n_rounds, tail_placed, full_sweeps, capped,
+     placed_hist) = solve_rounds.__wrapped__(spec, enc)
     n_total = enc["node_idle"].shape[0]
-    # tail_placed is bounded by 8*round_min_progress+16; clamp to the
-    # int16 limb's range so an extreme round_min_progress config can't
-    # silently wrap the PROFILE counter (assignments are unaffected)
-    tail = jnp.stack([n_rounds & 0x7FFF, n_rounds >> 15,
-                      jnp.minimum(tail_placed, 0x7FFF)])
+    # tail_placed is bounded by 8*round_min_progress+16; clamp everything to
+    # the int16 limb's range so an extreme config can't silently wrap a
+    # PROFILE counter (assignments are unaffected)
+    tail = jnp.concatenate([
+        jnp.stack([n_rounds & 0x7FFF, n_rounds >> 15,
+                   jnp.minimum(tail_placed, 0x7FFF),
+                   jnp.minimum(full_sweeps, 0x7FFF),
+                   capped.astype(jnp.int32)]),
+        jnp.minimum(placed_hist, 0x7FFF)])
     if n_total <= 32766:  # static (trace-time) shape decision
         return jnp.concatenate([assign.astype(jnp.int16),
                                 tail.astype(jnp.int16)])
@@ -556,7 +587,8 @@ def solve_rounds_packed(spec: SolveSpec, layout, bufs):
 @functools.partial(jax.jit, static_argnames=("spec",))
 def solve_rounds(spec: SolveSpec, enc: dict):
     """Batched allocate session. Returns (assign [T] int32 node or -1,
-    rounds used).
+    rounds used, tail_placed, full-sweep rounds, capped flag,
+    placed-per-round histogram [PROF_SLOTS]).
 
     Per-task request/has-pod columns are derived on device from the class
     arrays (task_req = cls_req[task_cls]); the per-task float matrices never
@@ -565,14 +597,16 @@ def solve_rounds(spec: SolveSpec, enc: dict):
     t_total = enc["task_cls"].shape[0]
     j_total = enc["job_tie_rank"].shape[0]
     k_total = enc["cls_req"].shape[0]
-    chunk_k = min(CHUNK, k_total)
+    n_total = enc["node_idle"].shape[0]
     dt = enc["cls_req"].dtype
     enc = dict(
         enc,
         task_req=enc["cls_req"][enc["task_cls"]],
         task_has_pod=enc["cls_has_pod"][enc["task_cls"]],
     )
-    task_excl = (enc["cls_excl"][enc["task_cls"]]
+    task_cls = enc["task_cls"]
+    t_cap = t_total + 1  # capacity clamp: ranks never reach it
+    task_excl = (enc["cls_excl"][task_cls]
                  if spec.use_exclusion else None)
 
     task_job = enc["task_job"]
@@ -601,6 +635,13 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         tried_cons=jnp.bool_(False),  # conservative retry owed after stall
         dead=jnp.bool_(False),  # outer fixpoint reached
         capped=jnp.bool_(False),  # diminishing-returns exit (min_progress)
+        # carried masked score matrix + the dirty-column set: all columns
+        # start dirty, so the first round always takes a full refresh (or
+        # an all-column gather when dirty_k covers the whole axis)
+        scores=jnp.zeros((k_total, n_total), dt),
+        dirty=jnp.ones(n_total, bool),
+        placed_hist=jnp.zeros(PROF_SLOTS, jnp.int32),
+        full_sweeps=jnp.int32(0),
     )
     if spec.use_exclusion:
         st["excl_occ"] = enc["excl_occ0"]
@@ -608,7 +649,7 @@ def solve_rounds(spec: SolveSpec, enc: dict):
     # case, so the runaway bound is 2(T+J)+8 (see outer_body)
     round_budget = 2 * (t_total + j_total) + 8
 
-    def round_body(st, compact=False):
+    def round_body(st):
         job_rank = _job_rank(spec, enc, st["job_placed"], st["job_alloc"])
         task_rank = job_rank[task_job] * max_tasks_per_job + task_in_job
 
@@ -618,6 +659,42 @@ def solve_rounds(spec: SolveSpec, enc: dict):
                                  enc["eps"], enc["is_scalar"])
             active = active & ~over[task_queue]
 
+        idle, used, cnt = st["idle"], st["used"], st["cnt"]
+        occ = st.get("excl_occ")
+        neg = jnp.array(-jnp.inf, idle.dtype)
+
+        # -- carried-score maintenance: dirty-column rescoring -------------
+        # scores depend only on per-column state (idle/used/cnt/occupancy),
+        # so patching the touched columns reproduces a full recompute
+        # bit-for-bit; a touch set past the gather budget (first round,
+        # bulk commits, large rollbacks) falls back to the chunked sweep
+        if spec.dirty_k > 0:
+            n_dirty = jnp.sum(st["dirty"].astype(jnp.int32))
+            scores = lax.cond(
+                n_dirty > jnp.int32(spec.dirty_k),
+                lambda _: _refresh_scores(spec, enc, idle, used, cnt, occ),
+                lambda _: _rescore_dirty(spec, enc, idle, used, cnt, occ,
+                                         st["scores"], st["dirty"]),
+                None)
+        else:
+            scores = _refresh_scores(spec, enc, idle, used, cnt, occ)
+        n_feas = jnp.sum((scores > neg).astype(jnp.int32), axis=-1)
+
+        # a class is live iff any of its tasks is still active (classes can
+        # REVIVE when a rollback drops an overused queue below deserved);
+        # per-class active demand feeds the binpack capacity apportioning:
+        # with a packing policy every class walks the SAME node order, so
+        # each must claim only its demand share of a node's estimated
+        # capacity or the round over-commits the first nodes K-fold
+        cls_live = jnp.zeros(k_total, bool).at[task_cls].max(active)
+        cls_demand = jnp.zeros(k_total, jnp.int32).at[task_cls].add(
+            active.astype(jnp.int32))
+        cls_frac = (cls_demand.astype(idle.dtype) / jnp.maximum(
+            jnp.sum(cls_demand), 1).astype(idle.dtype)) \
+            if spec.use_binpack else None
+        grank = _excl_grank(enc, cls_live) if spec.use_exclusion else None
+        rank = _rank_in_class(task_cls, active)
+
         # stalemate breaker, folded into the ONE traced body: when the
         # previous round made no progress, this round uses the class-best
         # choice — the capacity walk is deterministic, so a task whose
@@ -625,11 +702,66 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         # though other feasible nodes have room; the best-node choice
         # guarantees progress whenever anything feasible fits alone. A
         # conservative round that ALSO lands nothing sets tried_cons and
-        # the loop exits to the rollback fixpoint.
+        # the loop exits to the rollback fixpoint. The class-best node is
+        # the window's first element, so a stall never needs the full
+        # fallback — strictly stronger than falling back would be.
         cons = ~st["progress"]
-        choice, cons_choice = _choices(
-            spec, enc, st["idle"], st["used"], st["cnt"], active,
-            excl_occ=st.get("excl_occ"), compact=compact)
+
+        if spec.window_k > 0:
+            k_eff = spec.window_k
+            top_s, top_i = lax.top_k(scores, k_eff)       # [K, k] prefix
+            nom_w = _cap_walk(
+                spec, enc, top_i.astype(jnp.int32), top_s, enc["cls_req"],
+                enc["cls_excl"] if spec.use_exclusion else None,
+                enc["cls_has_pod"], cls_frac, idle, cnt, t_cap)
+            choice_w, cons_choice, slot_w, final_w = _select(
+                spec, enc, task_cls, active, rank, n_feas, grank,
+                top_i.astype(jnp.int32), *nom_w)
+            # -- coverage bit: is the windowed answer provably full-width? --
+            # exact when the window holds the class's whole feasible set, or
+            # when both the capacity-walk slot and the final (rotated /
+            # spread) position land strictly before the window's last
+            # equal-score group — the one group the window may truncate
+            # (its g_size/g_end, and hence the rotation, could differ from
+            # full width). Packing classes don't rotate, so any in-window
+            # slot is safe for them.
+            g_start_w = nom_w[1]
+            all_in = n_feas <= k_eff                        # [K]
+            if spec.use_binpack and not spec.use_exclusion:
+                safe_end = jnp.full(k_total, k_eff, jnp.int32)
+            elif spec.use_binpack:
+                safe_end = jnp.where(enc["cls_excl"] >= 0,
+                                     g_start_w[:, k_eff - 1],
+                                     jnp.int32(k_eff))
+            else:
+                safe_end = g_start_w[:, k_eff - 1]
+            safe_end = jnp.where(all_in, jnp.int32(k_eff), safe_end)
+            exact = all_in[task_cls] | (
+                (slot_w < safe_end[task_cls]) & (final_w < safe_end[task_cls]))
+            uncovered = jnp.zeros(k_total, bool).at[task_cls].max(
+                active & ~exact)
+            # stall rounds take cons_choice (exact by construction), so the
+            # fallback only runs for real windowed rounds
+            run_full = jnp.any(uncovered) & ~cons
+
+            def full_branch(_):
+                nom_f = _nominate_full(spec, enc, scores, idle, cnt,
+                                       cls_frac, t_cap)
+                ch_f, _, _, _ = _select(spec, enc, task_cls, active, rank,
+                                        n_feas, grank, *nom_f)
+                return ch_f
+
+            choice_full = lax.cond(
+                run_full, full_branch,
+                lambda _: jnp.full(t_total, -1, jnp.int32), None)
+            choice = jnp.where(uncovered[task_cls], choice_full, choice_w)
+            did_full = run_full
+        else:
+            nom_f = _nominate_full(spec, enc, scores, idle, cnt, cls_frac,
+                                   t_cap)
+            choice, cons_choice, _, _ = _select(
+                spec, enc, task_cls, active, rank, n_feas, grank, *nom_f)
+            did_full = jnp.bool_(True)
         choice = jnp.where(cons, cons_choice, choice)
         if spec.use_exclusion:
             # within-round mutual exclusion: of the tasks of one group
@@ -669,12 +801,12 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         if spec.round_min_progress > 1:
             # diminishing-returns exit: a nonzero round below the progress
             # floor means the remaining stragglers cost a fixed-price
-            # device round each few — the serial residue pass places them
-            # for microseconds apiece instead (assign=-2 marking below).
-            # Bounded: only when the remainder is small (<= 8x the floor,
-            # ~3% of the axis) — a large remainder is either worth more
-            # rounds or unplaceable (which ends via zero progress anyway),
-            # and must not be dumped on the serial pass wholesale
+            # device round each few — the straggler rounds + serial residue
+            # pass place them instead (assign=-2 marking below). Bounded:
+            # only when the remainder is small (<= 8x the floor, ~3% of the
+            # axis) — a large remainder is either worth more rounds or
+            # unplaceable (which ends via zero progress anyway), and must
+            # not be dumped on the serial pass wholesale
             remaining = jnp.sum((st["active"] & ~accept).astype(jnp.int32))
             capped = capped | (
                 any_accept & (placed_n < jnp.int32(spec.round_min_progress))
@@ -692,6 +824,14 @@ def solve_rounds(spec: SolveSpec, enc: dict):
             progress=any_accept,
             tried_cons=cons & ~any_accept,
             capped=capped,
+            scores=scores,
+            # the columns this round's commit touched are next round's
+            # rescore set (accept=False rows write False — a no-op)
+            dirty=jnp.zeros_like(st["dirty"]).at[node].max(accept),
+            placed_hist=st["placed_hist"].at[
+                jnp.minimum(st["rounds"], jnp.int32(PROF_SLOTS - 1))
+            ].add(placed_n.astype(jnp.int32)),  # sum promotes under x64
+            full_sweeps=st["full_sweeps"] + did_full.astype(jnp.int32),
         )
 
     def rollback(st):
@@ -727,6 +867,10 @@ def solve_rounds(spec: SolveSpec, enc: dict):
             ns_alloc=st["ns_alloc"].at[task_ns].add(-dreq),
             progress=jnp.bool_(True),
             dead=~jnp.any(cand),
+            # freed columns join the pending dirty set (the last round's
+            # touches have not been rescored yet); a large rollback simply
+            # overflows the gather budget into a full refresh
+            dirty=st["dirty"] | jnp.zeros_like(st["dirty"]).at[node].max(roll),
         ), jnp.any(cand)
 
     def outer_cond(st):
@@ -739,31 +883,15 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         # Budget 2(T+J): each stall pair (normal + conservative) either
         # places >= 1 task or exits to a rollback that retires one job.
         # A capped (diminishing-returns) exit is terminal: no rollback —
-        # the serial residue pass owns the stragglers AND any still-short
-        # gangs, with the oracle's exact Statement semantics.
+        # the straggler rounds + serial residue pass own the stragglers AND
+        # any still-short gangs, with the oracle's exact Statement
+        # semantics.
         def inner_cond(s):
             return (s["progress"] | ~s["tried_cons"]) \
                 & jnp.any(s["active"]) & (s["rounds"] < round_budget) \
                 & ~s["capped"]
 
-        if k_total > CHUNK:
-            # two sequential phases, not a per-round branch: live classes
-            # only ever shrink, so once the live set fits one sweep chunk
-            # every later round takes the compacted path. Sequential
-            # while_loops keep each body a straight-line program (a
-            # lax.cond here can lower to executing BOTH sweeps per round).
-            def live_over_chunk(s):
-                live = jnp.zeros(k_total, bool).at[
-                    enc["task_cls"]].max(s["active"])
-                return jnp.sum(live.astype(jnp.int32)) > chunk_k
-
-            st = lax.while_loop(
-                lambda s: inner_cond(s) & live_over_chunk(s),
-                round_body, st)
-            st = lax.while_loop(
-                inner_cond, functools.partial(round_body, compact=True), st)
-        else:
-            st = lax.while_loop(inner_cond, round_body, st)
+        st = lax.while_loop(inner_cond, round_body, st)
         st = lax.cond(
             st["capped"],
             lambda s: dict(s, dead=jnp.bool_(True)),
@@ -773,17 +901,45 @@ def solve_rounds(spec: SolveSpec, enc: dict):
 
     st = lax.while_loop(outer_cond, outer_body, st)
 
+    if spec.round_min_progress > 1 and spec.straggler_rounds > 0:
+        # batched straggler rounds: the capped exit used to dump its whole
+        # <= 8x-floor remainder on the one-task-per-step tail pass (cfg6:
+        # a 229-step sequential tail). With carried scores + windows a
+        # narrow round is cheap, so run a few more batched rounds over the
+        # stragglers first — the tail then sees only what round semantics
+        # genuinely cannot place. Bit-identical between windowed and
+        # full-width modes because round_body is.
+        def strag_cond(s):
+            return s["capped"] & s["progress"] & jnp.any(s["active"]) \
+                & (s["extra"] < jnp.int32(spec.straggler_rounds)) \
+                & (s["rounds"] < round_budget)
+
+        st = dict(st, extra=jnp.int32(0), progress=jnp.bool_(True))
+        st = lax.while_loop(
+            strag_cond,
+            lambda s: dict(round_body(s), extra=s["extra"] + 1), st)
+        st.pop("extra")
+
+    # profile + score state leave the carry before the tail pass: the tail
+    # is a ~hundreds-iteration scalar loop and must not drag [K, N] state
+    placed_hist = st.pop("placed_hist")
+    full_sweeps = st.pop("full_sweeps")
+    st.pop("scores")
+    st.pop("dirty")
+
     def tail_pass(st):
         """Sequential per-task placement of the diminishing-returns
         remainder, on device, in the serial visit order: one task per step
         (lowest live task rank), class-row feasibility mask, fused score,
         argmax node (first-max == lowest node index, the serial tie-break),
         scatter-commit. The cap condition bounds the remainder at
-        8 * round_min_progress, so ~300 tiny [N]-vector steps replace a
-        host residue pass that costs ~0.7 ms per straggler. Tasks the
-        sweep cannot place are retired with assign -1 (the kernel's mask
-        equals the serial predicate verdict for modeled tasks); gangs left
-        short are stripped and re-enqueued below exactly as before."""
+        8 * round_min_progress, so a few hundred tiny [N]-vector steps
+        replace a host residue pass that costs ~0.7 ms per straggler (and
+        the straggler rounds above have usually shrunk it to a handful).
+        Tasks the sweep cannot place are retired with assign -1 (the
+        kernel's mask equals the serial predicate verdict for modeled
+        tasks); gangs left short are stripped and re-enqueued below exactly
+        as before."""
         tail_budget = jnp.int32(8 * max(spec.round_min_progress, 1) + 16)
 
         def cond(s):
@@ -918,7 +1074,8 @@ def solve_rounds(spec: SolveSpec, enc: dict):
     assign = jnp.where(
         st["capped"] & want_retry & (assign < 0),
         -2, assign)
-    return assign, st["rounds"], st.get("tail_placed", jnp.int32(0))
+    return (assign, st["rounds"], st.get("tail_placed", jnp.int32(0)),
+            full_sweeps, st["capped"], placed_hist)
 
 
 def _le_eps_rows(l, r, eps, is_scalar):

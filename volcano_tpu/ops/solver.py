@@ -35,6 +35,47 @@ def _bucket(n: int) -> int:
     return b
 
 
+def _window_fields(arrays) -> Dict[str, int]:
+    """Candidate-window sizing for the rounds kernel, off the bucket ladder.
+
+    window_k bounds the per-class top-k node nomination: sized from class
+    demand x capacity slack — the largest number of nodes any one class
+    plausibly needs to cover its active demand (demand / mean-idle-per-node
+    capacity), doubled for slack, then bucketed so the jit-static spec
+    stays stable across steady-state sessions (VT002 contract: any k not
+    drawn from the ladder re-keys the compiled program on every churn).
+    dirty_k bounds the dirty-column rescoring gather the same way. Both 0
+    (full-width sweeps, the pre-window behavior and the parity-fuzz
+    reference) when the window would cover most of the node axis anyway,
+    or when VOLCANO_TPU_WINDOW=0 forces the old path."""
+    import os
+
+    if os.environ.get("VOLCANO_TPU_WINDOW", "1") == "0":
+        return {"window_k": 0, "dirty_k": 0}
+    nb = int(np.asarray(arrays["node_idle"]).shape[0])
+    task_cls = np.asarray(arrays["task_cls"])
+    kb = int(np.asarray(arrays["cls_req"]).shape[0])
+    demand = np.bincount(task_cls, minlength=kb).astype(np.float64)
+    idle = np.asarray(arrays["node_idle"], dtype=np.float64)
+    req = np.asarray(arrays["cls_req"], dtype=np.float64)
+    mean_idle = idle.mean(axis=0) if idle.size else np.zeros(req.shape[1])
+    with np.errstate(divide="ignore", invalid="ignore"):
+        per_node = np.where(req > 0, mean_idle[None, :]
+                            / np.where(req > 0, req, 1.0), np.inf)
+    cap = per_node.min(axis=1)  # nodes one task-class instance needs^-1
+    cap = np.where(np.isfinite(cap), np.clip(cap, 1.0, None),
+                   float(max(task_cls.shape[0], 1)))
+    need = int(np.ceil(demand / cap).max(initial=1.0))
+    k = _bucket(max(16, 2 * need))
+    if 2 * k > nb:
+        # window would span most of the axis: pruning buys nothing and the
+        # coverage machinery would only add per-round overhead
+        return {"window_k": 0, "dirty_k": 0}
+    return {"window_k": k,
+            "dirty_k": min(_bucket(max(4 * k, 64)),
+                           _bucket(max(nb // 8, 64)))}
+
+
 def _pad_axis(a: np.ndarray, axis: int, size: int, fill=0):
     if a.shape[axis] == size:
         return a
@@ -365,9 +406,16 @@ class BatchAllocator:
                 # cheaper than the serial pass they would shed
                 tb = int(arrays["task_cls"].shape[0])
                 kb = int(arrays["cls_req"].shape[0])
+                wf = _window_fields(arrays)
                 spec = enc.spec._replace(
                     round_min_progress=(
-                        max(2, tb // 128) if kb > rounds_mod.CHUNK else 0))
+                        max(2, tb // 128) if kb > rounds_mod.CHUNK else 0),
+                    # a few cheap narrow rounds over the capped remainder
+                    # before the sequential tail (rounds.py straggler
+                    # rounds); each costs one windowed round (~no full
+                    # sweep) and typically halves the tail
+                    straggler_rounds=4 if kb > rounds_mod.CHUNK else 0,
+                    window_k=wf["window_k"], dirty_k=wf["dirty_k"])
                 if self.mesh is None:
                     # grouped packed transfer + device cache: unchanged
                     # groups never re-cross the (tunneled) PJRT hop, and the
@@ -378,19 +426,39 @@ class BatchAllocator:
                     tp = time.perf_counter()
                     out = np.asarray(rounds_mod.solve_rounds_packed(
                         spec, layout, staged))
-                    assign = out[:-3].astype(np.int32, copy=False)
-                    n_rounds = int(out[-3]) | (int(out[-2]) << 15)
-                    tail_placed = int(out[-1])
+                    pt = rounds_mod.PROF_TAIL
+                    assign = out[:-pt].astype(np.int32, copy=False)
+                    meta = out[-pt:].astype(np.int64)
+                    n_rounds = int(meta[0]) | (int(meta[1]) << 15)
+                    tail_placed = int(meta[2])
+                    full_sweeps = int(meta[3])
+                    round_capped = bool(meta[4])
+                    placed_hist = meta[5:]
                     self.profile["pack_s"] = tp - t1
                     self.profile["dispatch_s"] = time.perf_counter() - tp
                 else:
                     # mesh path keeps per-array puts: node-axis arrays carry
                     # NamedShardings that packing would destroy
-                    assign, n_rounds, tail_placed = rounds_mod.solve_rounds(
+                    (assign, n_rounds, tail_placed, full_sweeps,
+                     round_capped, placed_hist) = rounds_mod.solve_rounds(
                         spec, rounds_arrays)
                     tail_placed = int(tail_placed)
+                    full_sweeps = int(full_sweeps)
+                    round_capped = bool(round_capped)
+                    placed_hist = np.asarray(placed_hist)
                 assign = np.asarray(assign)
                 self.profile["rounds"] = int(n_rounds)
+                # candidate-window round profile: how many rounds needed the
+                # full-width exactness fallback, the jit-static window/dirty
+                # buckets, and the placed-per-round histogram (clamped to
+                # PROF_SLOTS slots, values to the int16 limb)
+                self.profile["full_sweep_rounds"] = full_sweeps
+                self.profile["window_k"] = spec.window_k
+                self.profile["dirty_k"] = spec.dirty_k
+                self.profile["round_capped"] = round_capped
+                self.profile["round_placed"] = [
+                    int(x) for x in placed_hist[
+                        :min(int(n_rounds), rounds_mod.PROF_SLOTS)]]
                 if tail_placed:
                     # diminishing-returns cap fired and the device tail
                     # placed the stragglers (rounds.py tail_pass). This is

@@ -52,13 +52,24 @@ class AllocateAction(Action):
                 # alloc assist (vectorized window + cached score rows, live
                 # residual affinity/ports checks) replaces the per-node
                 # closure sweeps with bit-identical selections.
+                import time
+
                 from volcano_tpu.ops import preemptview
 
                 logger.info(
                     "allocate: serial residue pass (%d residue tasks, "
                     "%d unplaced)", residue, unplaced)
+                t0 = time.perf_counter()
                 self._serial_execute(
                     ssn, assist=preemptview.build_alloc_assist(ssn))
+                # the tail the device solve left to the host, as first-class
+                # profile terms (bench: tpu_residue_ms / tpu_residue_tasks)
+                # — the candidate-window straggler rounds exist to shrink
+                # exactly these numbers
+                prof["residue_pass_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 3)
+                prof["residue_pass_tasks"] = residue + (
+                    unplaced if prof.get("has_releasing") else 0)
             return
         self._serial_execute(ssn)
 

@@ -496,6 +496,23 @@ def main() -> int:
         ]
     write_record(results, final=final)
     print(json.dumps(final))
+    # compact trajectory line, printed LAST: the driver keeps only the final
+    # ~2,000 chars of stdout (cfg1/2/3/5 records were lost in rounds 3 and
+    # 4 behind the full record above), so the whole-sweep summary — and
+    # cfg4's per-action eviction-path timings — must fit in the tail
+    summary = {}
+    for r in results:
+        entry = {
+            "e2e_ms": r.get("tpu_e2e_median_ms", r.get("serial_e2e_ms")),
+            "speedup": round(r.get("speedup", 0.0), 3),
+        }
+        if r["config"] == 4 and "tpu_action_ms" in r:
+            entry["action_ms"] = {
+                k: v for k, v in r["tpu_action_ms"].items()
+                if k in ("preempt", "reclaim", "backfill")}
+        summary[f"cfg{r['config']}"] = entry
+    print(json.dumps({"summary": summary}, separators=(",", ":")),
+          flush=True)
     return 0
 
 

@@ -33,3 +33,17 @@ def host_probe(x):
 def debug_solve(arrays):
     probe = arrays["req"].item()  # vclint: disable=VT001 - debug-only kernel, gated off the warm path
     return probe
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def solve_evict_walk(spec, enc):
+    # the victim cut stays traced end to end: jnp reductions, no host casts
+    got = jnp.cumsum(enc["vic_req"], axis=1)
+    covered = jnp.all(enc["need"] < got[-1])
+    return jnp.where(covered, jnp.argmax(got[-1]), -1)
+
+
+def encode_victims(nodes):
+    # host-side victim-axis encode: numpy is fine outside the jit region
+    rows = np.zeros((len(nodes), 4, 2))
+    return rows

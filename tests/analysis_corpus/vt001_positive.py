@@ -30,3 +30,14 @@ def _reachable_helper(total, budget, host, n, t0):
 @jax.jit
 def solve_bare_decorator(arrays):
     return arrays["req"].item()  # vclint-expect: VT001
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def solve_evict_walk(spec, enc):
+    # victim-axis walk: host syncs on traced cut state break the one-
+    # dispatch eviction contract
+    got = enc["vic_req"].sum(axis=1)
+    covered = bool(got[0])  # vclint-expect: VT001
+    chosen = np.argmax(got)  # vclint-expect: VT001
+    t_cut = time.perf_counter()  # vclint-expect: VT001
+    return covered, chosen, t_cut

@@ -45,3 +45,11 @@ def window_rounds(scores, live_nodes, spec):
     k = _bucket(len(live_nodes))
     top = lax.top_k(scores, k)
     return top, lax.top_k(scores, spec.window_k)
+
+
+def evict_dispatch(vic_rows, jobs, spec):
+    # victim-axis width off the bucket ladder: compile-stable across
+    # running-pod churn
+    v = _bucket(len(vic_rows[0]))
+    vic_req = np.zeros((8, v, 2))
+    return solve_preempt(spec, {"vic_req": vic_req})

@@ -34,3 +34,12 @@ def window_rounds(scores, live_nodes):
     top = lax.top_k(scores, k)  # vclint-expect: VT002
     w = scores.shape[-1] // 4
     return top, lax.top_k(scores, k=w)  # vclint-expect: VT002
+
+
+def evict_dispatch(vic_rows, jobs, spec):
+    # victim-axis width is a jit-static shape: a raw per-node victim count
+    # re-keys the eviction program on every running-pod churn
+    v = len(vic_rows[0])
+    vic_req = np.zeros((8, v, 2))  # vclint-expect: VT002
+    spec2 = EvictSpec(kind="preempt", log_rows=len(jobs))  # vclint-expect: VT002
+    return solve_preempt(spec2, {"vic_req": vic_req})  # vclint-expect: VT002

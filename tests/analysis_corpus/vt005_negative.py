@@ -26,3 +26,10 @@ def commutative_fold(names, weight):
     for n in scratch:  # vclint: disable=VT005 - feeds a commutative sum; order cannot change the result
         total += weight(n)
     return total
+
+
+def encode_victim_axis(nodes):
+    # victim claimee order from dict iteration (insertion-ordered) plus a
+    # sorted dedup: deterministic across replicas
+    vic_jobs = {t.job for nd in nodes for t in nd.tasks}
+    return [job_row(j) for j in sorted(vic_jobs)]

@@ -18,3 +18,11 @@ def merge(seen, extra):
     while combined:
         out.append(combined.pop())  # vclint-expect: VT005
     return out
+
+
+def encode_victim_axis(nodes):
+    # victim claimee order must be deterministic: set iteration over the
+    # victim jobs reorders the cumulative drf/proportion walks per process
+    vic_jobs = {t.job for nd in nodes for t in nd.tasks}
+    rows = [job_row(j) for j in vic_jobs]  # vclint-expect: VT005
+    return np.array(rows)

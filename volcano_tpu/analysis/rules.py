@@ -170,13 +170,15 @@ class BucketShape(Rule):
 
     id = "VT002"
     title = "unbucketed dynamic shape reaches a jit-static sink"
-    patterns = ("*/ops/solver.py", "*/ops/rounds.py")
+    patterns = ("*/ops/solver.py", "*/ops/rounds.py", "*/ops/evict.py")
 
     SANITIZERS = {"_bucket"}
     BLESSED_CALLS = {"pad_encoded"}
     PAD_FUNCS = {"_pad_axis"}
-    SPEC_CTORS = {"SolveSpec"}
-    KERNEL_ENTRIES = {"solve_allocate", "solve_rounds", "solve_rounds_packed"}
+    SPEC_CTORS = {"SolveSpec", "EvictSpec"}
+    KERNEL_ENTRIES = {"solve_allocate", "solve_rounds", "solve_rounds_packed",
+                      "solve_preempt", "solve_reclaim", "solve_backfill",
+                      "_solve_packed"}
     ALLOC_FUNCS = {"zeros", "ones", "empty", "full"}
     # window-size sinks: arg 1 (or k=) is a static shape in the compiled
     # program — an unbucketed k is a per-churn retrace
@@ -616,7 +618,7 @@ class HotPathDeterminism(Rule):
 
     id = "VT005"
     title = "unsorted set iteration on a hot path"
-    patterns = ("*/ops/encoder.py", "*/ops/solver.py",
+    patterns = ("*/ops/encoder.py", "*/ops/solver.py", "*/ops/evict.py",
                 "*/scheduler/cache/*.py", "*/controllers/*.py")
 
     _SET_CTORS = {"set", "frozenset"}

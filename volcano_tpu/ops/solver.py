@@ -459,15 +459,14 @@ class BatchAllocator:
                 self.profile["round_placed"] = [
                     int(x) for x in placed_hist[
                         :min(int(n_rounds), rounds_mod.PROF_SLOTS)]]
-                if tail_placed:
-                    # diminishing-returns cap fired and the device tail
-                    # placed the stragglers (rounds.py tail_pass). This is
-                    # a count of tail placement ATTEMPTS: the post-tail
-                    # gang-atomicity strip may later revoke placements of
-                    # gangs that stayed short, and those revocations are
-                    # not subtracted here — treat as an upper bound on
-                    # tail contribution, not a net figure
-                    self.profile["tail_placed"] = tail_placed
+                # always emitted (0 when the tail never ran) so bench
+                # consumers need no existence checks. This is a count of
+                # tail placement ATTEMPTS: the post-tail gang-atomicity
+                # strip may later revoke placements of gangs that stayed
+                # short, and those revocations are not subtracted here —
+                # treat as an upper bound on tail contribution, not a net
+                # figure
+                self.profile["tail_placed"] = tail_placed
             else:
                 assign, rr = kernels.solve_allocate(
                     enc.spec, arrays, np.int32(enc.rr0), np.int32(enc.num_to_find)

@@ -41,6 +41,11 @@ class AllocateAction(Action):
         solver = getattr(ssn, "batch_allocator", None)
         if solver is not None and solver(ssn):
             prof = solver.profile
+            # residue-family keys are always present (0 when the serial
+            # residue pass never ran) so bench consumers need no
+            # existence checks
+            prof.setdefault("residue_pass_ms", 0.0)
+            prof.setdefault("residue_pass_tasks", 0)
             residue = prof.get("residue", 0)
             unplaced = prof.get("tasks", 0) - prof.get("placed", 0)
             if residue or (prof.get("has_releasing") and unplaced):

@@ -20,7 +20,17 @@ class BackfillAction(Action):
         return "backfill"
 
     def execute(self, ssn) -> None:
+        from volcano_tpu.ops import evict as evict_mod
         from volcano_tpu.ops import preemptview
+
+        # batched backfill (ops/evict.py): one device dispatch decides
+        # every zero-request placement (first feasible node in name order
+        # under the evolving pod-count); the host replays via ssn.allocate
+        # with the same FitErrors/replay-budget machinery as below.
+        # VOLCANO_TPU_EVICT=0 forces this oracle path.
+        plan = evict_mod.build(ssn, "backfill")
+        if plan is not None and plan.run():
+            return
 
         # dense per-signature feasibility rows (same candidates, same name
         # order as the serial walk) when tpuscore is on; the predicate

@@ -31,7 +31,19 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
+        from volcano_tpu.ops import evict as evict_mod
         from volcano_tpu.ops import preemptview, victimview
+
+        # batched device eviction (ops/evict.py): the whole action — job
+        # heaps, candidate windows, victim tiers, eviction cuts, gang
+        # commit/discard — runs as ONE packed device dispatch and the host
+        # replays the committed ops through the real Statements. Bindings
+        # and evictions are identical to the walk below within the modeled
+        # envelope (VOLCANO_TPU_EVICT=0 forces this oracle path; see
+        # tests/test_evict_kernel.py).
+        plan = evict_mod.build(ssn, "preempt")
+        if plan is not None and plan.run():
+            return
 
         # dense (preemptor x node) feasibility/score rows replace the
         # serial per-task O(nodes) closure sweeps when tpuscore is on;

@@ -31,7 +31,17 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn) -> None:
+        from volcano_tpu.ops import evict as evict_mod
         from volcano_tpu.ops import preemptview, victimview
+
+        # batched device eviction (ops/evict.py): queue rotation, tiered
+        # victim masks, deserved-floor walks and the eviction cuts run as
+        # one packed device dispatch; the host replays the op log through
+        # ssn.evict/ssn.pipeline in serial order. VOLCANO_TPU_EVICT=0
+        # forces the oracle walk below (tests/test_evict_kernel.py).
+        plan = evict_mod.build(ssn, "reclaim")
+        if plan is not None and plan.run():
+            return
 
         # dense per-signature feasibility rows replace the per-task O(nodes)
         # predicate closure sweep when tpuscore is on (same candidates, name

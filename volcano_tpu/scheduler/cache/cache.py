@@ -625,8 +625,7 @@ class SchedulerCache:
     def evict(self, task_info: TaskInfo, reason: str) -> None:
         mirror = self._mirror()
         with self._lock:
-            self.snap_keeper.mark_job(task_info.job)
-            self.snap_keeper.mark_node(task_info.node_name)
+            self.snap_keeper.mark_evict(task_info.job, task_info.node_name)
             if mirror is not None:
                 task, pod = mirror.mirror_evict(task_info)
             else:

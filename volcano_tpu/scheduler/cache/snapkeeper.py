@@ -77,7 +77,8 @@ class SnapshotKeeper:
         self.stats = {"rebuilds": 0, "incremental": 0,
                       "reused_jobs": 0, "cloned_jobs": 0,
                       "reused_nodes": 0, "cloned_nodes": 0,
-                      "axis_rebuilds": 0, "axis_rows_refreshed": 0}
+                      "axis_rebuilds": 0, "axis_rows_refreshed": 0,
+                      "evict_marks": 0}
 
     # -- marks (called under the cache lock) --------------------------------
 
@@ -88,6 +89,15 @@ class SnapshotKeeper:
     def mark_node(self, name: str) -> None:
         if name:
             self.dirty_nodes.add(name)
+
+    def mark_evict(self, job_uid: str, node_name: str) -> None:
+        """Eviction effector path: dirty both sides of the eviction in one
+        call and count it — the batched eviction replays land here exactly
+        like the serial walk, which is what keeps the next incremental
+        snapshot honest about RELEASING tasks."""
+        self.mark_job(job_uid)
+        self.mark_node(node_name)
+        self.stats["evict_marks"] += 1
 
     def invalidate(self) -> None:
         self.generation += 1

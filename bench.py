@@ -96,9 +96,11 @@ def _session_once(cache, tiers, actions, mesh=None):
 
 
 def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
-               mesh=None, verbose=True, warm_iters: int = 5):
+               mesh=None, verbose=True, warm_iters: int = 5,
+               scenario: str = None):
     warm_iters = max(warm_iters, 1)
     from volcano_tpu.bench.clusters import CONFIGS, build_config
+    from volcano_tpu.bench.clusters import build_scenario
 
     # build the native engines BEFORE any timed window — including the
     # serial baseline, whose session transition path also reaches for
@@ -109,8 +111,20 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
     native_ok = {"fastapply": _native.get_fastapply() is not None,
                  "fasttrans": _native.get_fasttrans() is not None}
 
-    bc = CONFIGS[cfg]
-    out = {"config": cfg, "name": bc.name, "scale": scale,
+    if scenario is None:
+        name = CONFIGS[cfg].name
+        build = build_config
+    else:
+        # --scenario: the cluster snapshot comes from a sim scenario file
+        # (volcano_tpu/sim/scenarios) through the SAME populate path the
+        # simulator uses — one cluster-shape source, two harnesses
+        import os as _os
+
+        name = f"scenario:{_os.path.splitext(_os.path.basename(scenario))[0]}"
+
+        def build(_cfg, s, _ref=scenario):
+            return build_scenario(_ref, s)
+    out = {"config": cfg, "name": name, "scale": scale,
            "native_engines": native_ok}
 
     if backend in ("serial", "both", "auto"):
@@ -120,7 +134,7 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
         est = None
         if backend == "auto" or cfg >= 3:
             probe_scale = min(scale, 0.02)
-            cache, st, _, actions, _ = build_config(cfg, probe_scale)
+            cache, st, _, actions, _ = build(cfg, probe_scale)
             t0 = time.perf_counter()
             probe = _session_once(cache, st, actions)
             probe_s = time.perf_counter() - t0
@@ -128,7 +142,7 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
             est = probe_s / unit * (scale * scale)
             if est > serial_budget:
                 serial_scale = max((serial_budget / (probe_s / unit)) ** 0.5, probe_scale)
-        cache, serial_tiers, _, actions, n_tasks = build_config(cfg, serial_scale)
+        cache, serial_tiers, _, actions, n_tasks = build(cfg, serial_scale)
         r = _session_once(cache, serial_tiers, actions)
         serial_s = r["actions_s"]
         open_close_s = r["open_s"] + r["close_s"]
@@ -156,7 +170,7 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
     if backend in ("tpu", "both", "auto"):
         import gc
 
-        cache, _, tpu_tiers, actions, n_tasks = build_config(cfg, scale)
+        cache, _, tpu_tiers, actions, n_tasks = build(cfg, scale)
         cold = _session_once(cache, tpu_tiers, actions, mesh=mesh)
         out["tpu_cold_ms"] = cold["actions_s"] * 1e3
         out["tpu_cold_profile"] = cold["profile"]
@@ -182,7 +196,7 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
         for _ in range(warm_iters):
             del cache
             gc.collect()
-            cache, _, tpu_tiers, actions, n_tasks = build_config(cfg, scale)
+            cache, _, tpu_tiers, actions, n_tasks = build(cfg, scale)
             # building the cluster allocates heavily; collect that debt
             # BEFORE the timed window so a generational collection isn't
             # charged to whichever session phase it randomly lands in (the
@@ -370,6 +384,11 @@ def main() -> int:
                     help="warm TPU sessions per config (>=1); the headline "
                          "binds on the MEDIAN e2e, and 5 samples keep one "
                          "link-jitter outlier from dragging it")
+    ap.add_argument("--scenario", default=None,
+                    help="source the cluster snapshot from a sim scenario "
+                         "file or committed scenario name "
+                         "(volcano_tpu/sim/scenarios) instead of the "
+                         "built-in configs")
     ap.add_argument("--mesh", action="store_true",
                     help="shard the node axis across all local devices")
     args = ap.parse_args()
@@ -472,11 +491,15 @@ def main() -> int:
     # time-boxed harness that kills the run mid-way still captures the
     # headline number in its tail; the combined line (with all_configs)
     # prints last and supersedes it when the run completes
-    cfgs = [args.config] if args.config is not None else [5, 1, 2, 3, 4, 6]
+    if args.scenario is not None:
+        cfgs = [0]  # one scenario-sourced run; headline falls through to it
+    else:
+        cfgs = [args.config] if args.config is not None else [5, 1, 2, 3, 4, 6]
     for cfg in cfgs:
         results.append(run_config(cfg, args.scale, args.backend,
                                   args.serial_budget, mesh=mesh,
-                                  warm_iters=args.warm_iters))
+                                  warm_iters=args.warm_iters,
+                                  scenario=args.scenario))
         write_record(results)
         if cfg == 5 and len(cfgs) > 1:
             print(json.dumps(headline_json(results[0])), flush=True)

@@ -37,3 +37,13 @@ def delegate(ssn, task, closer):
 def fire_and_forget(ssn, task, host):
     stmt = ssn.statement()
     stmt.pipeline(task, host)  # vclint: disable=VT004 - session-local pipeline, never committed by design
+
+
+def sim_slice_closes_statement(ssn, gang, host, ok):
+    stmt = ssn.statement()
+    for t in gang:
+        stmt.evict(t, "chaos")
+    if ok:
+        stmt.commit()
+    else:
+        stmt.discard()

@@ -15,3 +15,11 @@ def evict_no_close(ssn, victim):
     if victim.ready():
         return victim
     return None
+
+
+def sim_slice_drops_statement(ssn, gang, host):
+    # a sim harness replaying an eviction plan must close what it opens
+    stmt = ssn.statement()
+    for t in gang:
+        stmt.evict(t, "chaos")  # vclint-expect: VT004
+    return len(gang)

@@ -33,3 +33,11 @@ def encode_victim_axis(nodes):
     # sorted dedup: deterministic across replicas
     vic_jobs = {t.job for nd in nodes for t in nd.tasks}
     return [job_row(j) for j in sorted(vic_jobs)]
+
+
+def sim_fire_faults(engine, down_nodes, flip):
+    # the sim's replay contract: sorted() pins the event order
+    for name in sorted(down_nodes):
+        engine.schedule(name)
+    pending = {j for j in flip}
+    return [audit(j) for j in sorted(pending)]

@@ -26,3 +26,13 @@ def encode_victim_axis(nodes):
     vic_jobs = {t.job for nd in nodes for t in nd.tasks}
     rows = [job_row(j) for j in vic_jobs]  # vclint-expect: VT005
     return np.array(rows)
+
+
+def sim_fire_faults(engine, flap_names, flip):
+    # sim determinism: a chaos injector iterating its down-node SET while
+    # scheduling re-add events reorders the virtual event log per process
+    down_nodes = {n for n in flap_names}
+    for name in down_nodes:  # vclint-expect: VT005
+        engine.schedule(name)
+    pending = {j for j in flip}
+    return [audit(j) for j in pending]  # vclint-expect: VT005

@@ -178,6 +178,42 @@ class TestObservabilityEndpoints:
         finally:
             srv.stop()
 
+    def test_metrics_gauges_and_histogram_exposition(self):
+        """Gauges (pending pods / queue depth / sessions run) and the full
+        histogram exposition contract: per-label-set _sum/_count plus the
+        mandatory le=\"+Inf\" bucket equal to _count."""
+        metrics.reset()
+        metrics.update_action_duration("allocate", 0.002)
+        metrics.update_action_duration("allocate", 0.004)
+        metrics.update_action_duration("backfill", 0.001)
+        metrics.set_pending_pods(17)
+        metrics.set_queue_depth("default", 3)
+        metrics.set_queue_depth("batch", 9)
+        metrics.set_sessions_run(42)
+        srv = ObservabilityServer(":0").start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=5).read().decode()
+        finally:
+            srv.stop()
+        lines = body.splitlines()
+        # gauges, typed and labeled
+        assert "# TYPE volcano_pending_pods gauge" in lines
+        assert "volcano_pending_pods 17.0" in lines
+        assert 'volcano_queue_depth{queue="default"} 3.0' in lines
+        assert 'volcano_queue_depth{queue="batch"} 9.0' in lines
+        assert "volcano_sessions_run 42.0" in lines
+        # histogram per-label-set _sum/_count and the +Inf bucket
+        h = "volcano_action_scheduling_latency_microseconds"
+        assert f'{h}_count{{action="allocate"}} 2' in lines
+        assert f'{h}_sum{{action="allocate"}} 0.006' in lines
+        assert f'{h}_count{{action="backfill"}} 1' in lines
+        assert f'{h}_bucket{{action="allocate",le="+Inf"}} 2' in lines
+        assert f'{h}_bucket{{action="backfill",le="+Inf"}} 1' in lines
+        # e2e histogram (no labels) also carries its +Inf bucket
+        metrics.reset()
+
     def test_healthz(self):
         healthy = {"ok": True}
         srv = ObservabilityServer(

@@ -1,0 +1,275 @@
+"""volcano_tpu.sim — the virtual-time simulator gate (docs/DESIGN.md §12).
+
+Four layers:
+1. engine/clock units: event ordering, hash sensitivity, RNG stream
+   independence;
+2. smoke scenarios through the REAL stack (smoke_small fault-free,
+   smoke_chaos with every fault family) — zero auditor violations, and
+   the determinism contract: same seed ⇒ byte-identical event-log hash
+   IN-PROCESS (the strictest form — global counters, jit caches, and
+   helper state must all be properly reset between runs);
+3. auditor self-test: a deliberately reintroduced evict-accounting-leak /
+   phantom-pod corruption (the VOLCANO_TPU_EVICT=0-era bug class) MUST be
+   caught, with a repro bundle dumped;
+4. the cfg5-shaped scale gate: reduced-scale cfg5_storm end-to-end
+   through the real TPU rounds solve with warm assert-no-compiles
+   (full scale runs as slow).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import subprocess
+import sys
+
+import pytest
+
+from volcano_tpu.sim import (
+    RngStreams,
+    SimCluster,
+    VirtualClock,
+    load_scenario,
+    scale_scenario,
+)
+from volcano_tpu.sim.engine import SimEngine
+
+pytestmark = pytest.mark.sim
+
+
+# ---------------------------------------------------------------------------
+# 1. engine / clock units
+# ---------------------------------------------------------------------------
+
+
+class TestEngine:
+    def test_event_order_is_time_then_schedule_order(self):
+        clock = VirtualClock()
+        engine = SimEngine(clock)
+        seen = []
+        engine.schedule_at(2.0, "b", lambda: seen.append("b"))
+        engine.schedule_at(1.0, "a", lambda: seen.append("a"))
+        engine.schedule_at(2.0, "c", lambda: seen.append("c"))
+        engine.run_until(10.0)
+        assert seen == ["a", "b", "c"]
+        assert clock.now() == 10.0
+
+    def test_log_hash_tracks_content_and_time(self):
+        def run(detail):
+            clock = VirtualClock()
+            engine = SimEngine(clock)
+            engine.schedule_at(1.0, "x", lambda: detail)
+            engine.run_until(5.0)
+            return engine.log_hash()
+
+        assert run("same") == run("same")
+        assert run("same") != run("different")
+
+    def test_events_during_run_can_schedule_more(self):
+        clock = VirtualClock()
+        engine = SimEngine(clock)
+        seen = []
+
+        def tick():
+            seen.append(clock.now())
+            if clock.now() < 3.0:
+                engine.schedule_in(1.0, "tick", tick)
+
+        engine.schedule_at(1.0, "tick", tick)
+        engine.run_until(10.0)
+        assert seen == [1.0, 2.0, 3.0]
+
+    def test_virtual_timestamps_strictly_increase(self):
+        clock = VirtualClock()
+        stamps = [clock.timestamp() for _ in range(5)]
+        assert stamps == sorted(stamps)
+        assert len(set(stamps)) == 5
+
+    def test_rng_streams_stable_and_independent(self):
+        a1 = RngStreams(7).stream("workload").random()
+        # drawing from another stream first must not perturb this one
+        rngs = RngStreams(7)
+        rngs.stream("chaos:node_flap").random()
+        a2 = rngs.stream("workload").random()
+        assert a1 == a2
+        assert RngStreams(8).stream("workload").random() != a1
+
+
+# ---------------------------------------------------------------------------
+# 2. smoke scenarios (tier-1 gates)
+# ---------------------------------------------------------------------------
+
+
+def _run(name, seed, duration=None, mutate=None, repro_dir=None):
+    cfg = copy.deepcopy(load_scenario(name))
+    if mutate is not None:
+        mutate(cfg)
+    sim = SimCluster(cfg, seed=seed, repro_dir=repro_dir)
+    return sim.run(duration=duration)
+
+
+class TestSmokeScenarios:
+    def test_smoke_small_pipeline_converges_clean(self):
+        s = _run("smoke_small", seed=7)
+        assert s["sessions"] >= 15
+        assert s["binds"] > 0
+        assert s["jobs"]["completed"] > 0, s["jobs"]
+        assert s["audit"]["checks"] >= 15
+        assert s["audit"]["violations"] == 0, s["audit"]
+        # lifecycles actually churned: some pods finished
+        assert s["pods"]["succeeded"] > 0
+
+    def test_smoke_chaos_every_fault_family_clean(self):
+        s = _run("smoke_chaos", seed=3)
+        assert s["audit"]["violations"] == 0, s["audit"]
+        # the chaos actually happened — each seam was exercised
+        assert s["faults"].get("node_flap", 0) >= 1, s["faults"]
+        assert s["faults"].get("reset_storm", 0) >= 1, s["faults"]
+        assert s["session_kills"] >= 1
+        assert s["restarts"]["scheduler"] >= 1
+        # ring overflow forced the reset/re-list path with DELETED
+        # synthesis — the phantom-object protocol under test
+        pod_mirror = s["mirrors"]["Pod"]
+        assert pod_mirror["resets"] >= 1, s["mirrors"]
+        assert pod_mirror["synthesized_deletes"] >= 1, s["mirrors"]
+
+    def test_same_seed_identical_hash_in_process(self):
+        a = _run("smoke_small", seed=12, duration=16.0)
+        b = _run("smoke_small", seed=12, duration=16.0)
+        assert a["event_log_hash"] == b["event_log_hash"]
+        assert a["binds"] == b["binds"]
+        assert (a["audit"]["checks"], a["audit"]["violations"]) \
+            == (b["audit"]["checks"], b["audit"]["violations"])
+
+    def test_chaos_same_seed_identical_hash_different_seed_differs(self):
+        a = _run("smoke_chaos", seed=5, duration=40.0)
+        b = _run("smoke_chaos", seed=5, duration=40.0)
+        c = _run("smoke_chaos", seed=6, duration=40.0)
+        assert a["event_log_hash"] == b["event_log_hash"]
+        assert a["event_log_hash"] != c["event_log_hash"]
+
+    def test_trace_replay_lifecycle(self):
+        s = _run("trace_replay", seed=2)
+        assert s["jobs"]["submitted"] == 5
+        assert s["jobs"]["completed"] >= 2
+        assert s["jobs"]["failed"] == 1      # trace-c carries fail: true
+        assert s["jobs"]["cancelled"] == 1   # trace-d deleted at t=20
+        assert s["audit"]["violations"] == 0, s["audit"]
+
+    def test_queues_mix_evictions_run_clean(self):
+        s = _run("queues_mix", seed=5, duration=120.0)
+        assert s["audit"]["violations"] == 0, s["audit"]
+        # overcommit + priority spread + weighted queues actually drove
+        # the preempt/reclaim pipeline
+        assert s["evictions"] > 0
+        assert s["binds"] > 0
+
+
+# ---------------------------------------------------------------------------
+# 3. auditor self-test (seeded bug fixtures)
+# ---------------------------------------------------------------------------
+
+
+class TestAuditorSelfTest:
+    @pytest.mark.parametrize("kind,expected", [
+        ("accounting_leak", "cache_accounting"),
+        ("phantom_pod", "phantom_cache"),
+    ])
+    def test_seeded_bug_is_caught(self, tmp_path, kind, expected):
+        def mutate(cfg):
+            cfg["scheduler"]["conf"] = "default"
+            cfg["faults"] = {"seeded_bug": {"kind": kind, "at_s": 5.0}}
+
+        s = _run("smoke_small", seed=1, duration=12.0, mutate=mutate,
+                 repro_dir=str(tmp_path))
+        assert s["audit"]["violations"] > 0
+        assert expected in s["audit"]["kinds"], s["audit"]
+        bundles = sorted(tmp_path.glob("violation-*.json"))
+        assert bundles, "violation must dump a repro bundle"
+        bundle = json.loads(bundles[0].read_text())
+        assert bundle["seed"] == 1
+        assert bundle["violations"][0]["invariant"] == expected
+        assert "repro_command" in bundle
+        assert bundle["event_log_tail"], "bundle carries the log tail"
+
+    def test_clean_run_dumps_nothing(self, tmp_path):
+        s = _run("smoke_small", seed=7, duration=10.0,
+                 repro_dir=str(tmp_path))
+        assert s["audit"]["violations"] == 0
+        assert not list(tmp_path.glob("violation-*.json"))
+
+
+# ---------------------------------------------------------------------------
+# 4. cfg5-shaped scale gate (reduced scale; full scale = slow)
+# ---------------------------------------------------------------------------
+
+
+def _run_cfg5(scale, duration, seed=7):
+    cfg = scale_scenario(load_scenario("cfg5_storm"), scale)
+    sim = SimCluster(cfg, seed=seed, repro_dir=None)
+    return sim.run(duration=duration)
+
+
+class TestCfg5Scale:
+    def test_reduced_scale_real_tpu_solve_warm_no_compiles(self):
+        s = _run_cfg5(scale=0.01, duration=60.0)
+        # the storm placed to capacity and kept an overcommit backlog —
+        # the warm re-solve regime
+        assert s["binds"] > 300, s["binds"]
+        assert s["pods"]["pending"] > 0
+        assert s["audit"]["violations"] == 0, s["audit"]
+        # the REAL device rounds path ran (it compiled at least once)...
+        assert s["compiles"]["total"] >= 1, s["compiles"]
+        # ...and the steady state is retrace-free: warm sessions re-solve
+        # the same backlog through the SAME compiled program
+        assert s["compiles"]["after_warmup"] == 0, s["compiles"]
+        assert s["sessions"] >= 10
+
+    @pytest.mark.slow
+    def test_full_scale_cfg5_storm(self):
+        # 50k tasks x 10k nodes end-to-end: store submit -> controllers ->
+        # enqueue -> TPU rounds solve -> bind writeback, audited
+        s = _run_cfg5(scale=1.0, duration=25.0)
+        assert s["binds"] > 30000, s["binds"]
+        assert s["audit"]["violations"] == 0, s["audit"]
+        assert s["compiles"]["after_warmup"] == 0, s["compiles"]
+
+    @pytest.mark.slow
+    def test_chaos_soak_two_hours(self):
+        cfg = copy.deepcopy(load_scenario("chaos_soak"))
+        sim = SimCluster(cfg, seed=11, repro_dir=None)
+        s = sim.run()
+        assert s["sim_duration_s"] >= 7200.0
+        assert s["audit"]["violations"] == 0, s["audit"]
+        assert s["faults"].get("node_flap", 0) > 10
+        assert s["mirrors"]["Pod"]["resets"] > 10
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def test_run_emits_summary_tail_line(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "volcano_tpu.sim", "run", "smoke_small",
+             "--seed", "4", "--duration", "8", "--quiet",
+             "--repro-dir", str(tmp_path / "repro"),
+             "--json", str(tmp_path / "summary.json")],
+            capture_output=True, text=True, timeout=240)
+        assert out.returncode == 0, out.stderr[-2000:]
+        tail = out.stdout.strip().splitlines()[-1]
+        summary = json.loads(tail)
+        assert summary["scenario"] == "smoke_small"
+        assert summary["event_log_hash"]
+        assert (tmp_path / "summary.json").exists()
+
+    def test_list_names_committed_scenarios(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "volcano_tpu.sim", "list"],
+            capture_output=True, text=True, timeout=60)
+        names = out.stdout.split()
+        for expected in ("smoke_small", "smoke_chaos", "cfg5_storm",
+                         "chaos_soak", "queues_mix", "trace_replay"):
+            assert expected in names, names

@@ -1,0 +1,180 @@
+"""Watch-journal overflow under churn — the phantom-object fuzz.
+
+A consumer that lags past the journal's ring cap must converge back to
+ground truth through the reset/re-list protocol: no phantom objects (a
+delete that fell off the ring must still be observed via DELETED
+synthesis), no lost adds, no stale versions. Fuzzed on BOTH paths:
+
+1. the local path — sim/mirror.JournalMirror polling store/gateway.py's
+   _WatchJournal directly (deterministic, virtual-time style);
+2. the remote path — RemoteStore.watch long-polling a REAL ApiGateway
+   over HTTP with a deliberately tiny journal_cap, the PR-2
+   relist/DELETED-synthesis machinery.
+
+Plus the poll-protocol regression for the future-cursor case: a cursor
+beyond the journal's head (a client that outlived a gateway restart)
+must get the 410-style reset, not a silent wait that skips the gap.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from volcano_tpu.api import objects
+from volcano_tpu.scheduler.util.test_utils import build_pod
+from volcano_tpu.sim.mirror import JournalMirror
+from volcano_tpu.store.gateway import ApiGateway, _WatchJournal
+from volcano_tpu.store.remote import RemoteStore
+from volcano_tpu.store.store import Store, WatchHandler, object_key
+
+
+def _make_pod(i: int) -> objects.Pod:
+    pod = build_pod("fuzz", f"pod-{i:05d}", "", objects.POD_PHASE_PENDING,
+                    {"cpu": "100m", "memory": "64Mi"}, "")
+    pod.metadata.ensure_identity()
+    return pod
+
+
+def _churn(store: Store, rng: random.Random, live: dict, i: int) -> int:
+    """One random store mutation; returns the next pod index."""
+    roll = rng.random()
+    if not live or roll < 0.45:
+        pod = _make_pod(i)
+        store.create(pod)
+        live[object_key(pod)] = pod
+        return i + 1
+    key = rng.choice(sorted(live))
+    if roll < 0.75:
+        import copy
+
+        pod = copy.deepcopy(live[key])
+        pod.metadata.annotations["fuzz"] = str(i)
+        live[key] = store.update(pod)
+    else:
+        ns, name = key.split("/", 1)
+        store.delete("Pod", ns, name)
+        del live[key]
+    return i + 1
+
+
+class TestJournalPollProtocol:
+    def test_future_cursor_signals_reset(self):
+        store = Store()
+        journal = _WatchJournal(store, "Pod", cap=8)
+        store.create(_make_pod(0))
+        events, nxt, reset = journal.poll(0, 0.0)
+        assert not reset and len(events) == 1
+        # a cursor beyond the head (stale client after a journal rebuild)
+        events, nxt2, reset = journal.poll(nxt + 100, 0.0)
+        assert reset and events == []
+        assert nxt2 == nxt  # resume point is the real head
+
+    def test_fallen_off_ring_signals_reset(self):
+        store = Store()
+        journal = _WatchJournal(store, "Pod", cap=4)
+        idx = 0
+        for idx in range(10):
+            store.create(_make_pod(idx))
+        events, nxt, reset = journal.poll(0, 0.0)
+        assert reset, "cursor 0 predates the 4-event ring"
+        # resuming from the returned head is consistent
+        events, _, reset = journal.poll(nxt, 0.0)
+        assert not reset and events == []
+
+
+class TestLocalMirrorFuzz:
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_lagging_consumer_converges(self, seed):
+        rng = random.Random(seed)
+        store = Store()
+        mirror = JournalMirror(store, "Pod", cap=16)
+        live: dict = {}
+        idx = 0
+        for _ in range(40):
+            # a burst larger than the ring, then a maybe-skipped drain:
+            # the consumer repeatedly falls off the ring and must re-list
+            for _ in range(rng.randrange(1, 40)):
+                idx = _churn(store, rng, live, idx)
+            mirror.drain(rng=rng, skip_prob=0.5, error_prob=0.3)
+        assert mirror.resets > 0, "fuzz never overflowed the ring"
+        # faults stop; the protocol must converge to ground truth
+        mirror.catch_up()
+        diff = mirror.diff_vs_store()
+        assert diff == {"phantom": [], "missing": [], "stale": []}, diff
+        assert sorted(mirror.known) == sorted(object_key(p)
+                                              for p in store.list("Pod"))
+
+    def test_delete_burst_past_ring_synthesizes_deletes(self):
+        store = Store()
+        mirror = JournalMirror(store, "Pod", cap=8)
+        live: dict = {}
+        for i in range(20):
+            pod = _make_pod(i)
+            store.create(pod)
+            live[object_key(pod)] = pod
+        mirror.catch_up()
+        assert len(mirror.known) == 20
+        # delete EVERYTHING while the consumer sleeps — far past the ring
+        for key in sorted(live):
+            ns, name = key.split("/", 1)
+            store.delete("Pod", ns, name)
+        mirror.catch_up()
+        assert mirror.known == {}, "phantom objects survived the reset"
+        assert mirror.synthesized_deletes == 20
+
+
+class TestRemoteWatchFuzz:
+    def test_remote_consumer_lags_past_tiny_ring(self):
+        store = Store()
+        gateway = ApiGateway(store, journal_cap=16).start()
+        try:
+            remote = RemoteStore(f"127.0.0.1:{gateway.port}")
+            known: dict = {}
+            lock = threading.Lock()
+
+            def on_added(obj):
+                with lock:
+                    known[object_key(obj)] = obj.metadata.resource_version
+
+            def on_updated(old, new):
+                with lock:
+                    known[object_key(new)] = new.metadata.resource_version
+
+            def on_deleted(obj):
+                with lock:
+                    known.pop(object_key(obj), None)
+
+            remote.watch("Pod", WatchHandler(
+                added=on_added, updated=on_updated, deleted=on_deleted),
+                poll_timeout=0.2)
+
+            rng = random.Random(99)
+            live: dict = {}
+            idx = 0
+            for _ in range(6):
+                # bursts far past the 16-event ring while the poller's
+                # long-poll sleeps between rounds
+                for _ in range(60):
+                    idx = _churn(store, rng, live, idx)
+                time.sleep(0.05)
+
+            truth = {object_key(p): p.metadata.resource_version
+                     for p in store.list("Pod")}
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                with lock:
+                    snapshot = dict(known)
+                if snapshot == truth:
+                    break
+                time.sleep(0.1)
+            assert snapshot == truth, (
+                f"remote mirror did not converge: "
+                f"{len(set(snapshot) - set(truth))} phantom, "
+                f"{len(set(truth) - set(snapshot))} missing")
+            remote.stop_watches()
+        finally:
+            gateway.stop()

@@ -538,7 +538,8 @@ class StatementHygiene(Rule):
 
     id = "VT004"
     title = "statement never committed or discarded"
-    patterns = ("*/scheduler/actions/*.py", "*/ops/solver.py")
+    patterns = ("*/scheduler/actions/*.py", "*/ops/solver.py",
+                "*/sim/*.py")
 
     TENTATIVE = {"allocate", "pipeline", "evict"}
     CLOSING = {"commit", "discard"}
@@ -619,7 +620,11 @@ class HotPathDeterminism(Rule):
     id = "VT005"
     title = "unsorted set iteration on a hot path"
     patterns = ("*/ops/encoder.py", "*/ops/solver.py", "*/ops/evict.py",
-                "*/scheduler/cache/*.py", "*/controllers/*.py")
+                "*/scheduler/cache/*.py", "*/controllers/*.py",
+                # the sim's replay determinism contract (same seed =>
+                # identical event-log hash) dies the moment any component
+                # iterates an unordered set while making decisions
+                "*/sim/*.py")
 
     _SET_CTORS = {"set", "frozenset"}
     _SET_METHODS = {"union", "intersection", "difference",

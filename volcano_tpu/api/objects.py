@@ -60,7 +60,9 @@ class ObjectMeta:
         if not self.uid:
             self.uid = new_uid(self.name or "obj")
         if not self.creation_timestamp:
-            self.creation_timestamp = time.time()
+            from volcano_tpu.utils import clock
+
+            self.creation_timestamp = clock.now()
 
 
 # ---------------------------------------------------------------------------

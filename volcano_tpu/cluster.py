@@ -24,6 +24,7 @@ from volcano_tpu.controllers.queue import QueueController
 from volcano_tpu.scheduler.cache import SchedulerCache
 from volcano_tpu.scheduler.scheduler import Scheduler
 from volcano_tpu.store.store import Store
+from volcano_tpu.utils import clock
 
 
 class Kubelet:
@@ -44,7 +45,7 @@ class Kubelet:
             if pod.spec.node_name and pod.status.phase == objects.POD_PHASE_PENDING:
                 updated = copy.deepcopy(pod)
                 updated.status.phase = objects.POD_PHASE_RUNNING
-                updated.status.start_time = time.time()
+                updated.status.start_time = clock.now()
                 self.store.update_status(updated)
                 changed += 1
         return changed

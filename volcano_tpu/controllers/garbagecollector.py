@@ -30,8 +30,17 @@ class GarbageCollector:
         self.clock = clock
         # (fire_at, ns/name) min-heap standing in for the delaying queue
         self._heap: List[Tuple[float, str, str]] = []
-        store.watch("Job", WatchHandler(added=self._on_job,
-                                        updated=lambda old, new: self._on_job(new)))
+        self._watch_regs = [("Job", WatchHandler(
+            added=self._on_job,
+            updated=lambda old, new: self._on_job(new)))]
+        for kind, handler in self._watch_regs:
+            store.watch(kind, handler)
+
+    def detach(self) -> None:
+        """Unregister store watches (sim restart-injection / teardown)."""
+        for kind, handler in self._watch_regs:
+            self.store.unwatch(kind, handler)
+        self._watch_regs = []
 
     def _on_job(self, job: objects.Job) -> None:
         if not needs_cleanup(job):

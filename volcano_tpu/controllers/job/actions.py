@@ -98,9 +98,9 @@ class JobActions:
         job.status.version += 1
         self._rebuild_status(job, counts)
         if update_status is not None and update_status(job.status):
-            import time as _time
+            from volcano_tpu.utils import clock
 
-            job.status.state.last_transition_time = _time.time()
+            job.status.state.last_transition_time = clock.now()
         self._write_status(job)
 
         # delete the PodGroup (actions.go:123-130)
@@ -167,9 +167,9 @@ class JobActions:
 
         self._rebuild_status(job, counts, keep_controlled=True)
         if update_status is not None and update_status(job.status):
-            import time as _time
+            from volcano_tpu.utils import clock
 
-            job.status.state.last_transition_time = _time.time()
+            job.status.state.last_transition_time = clock.now()
         self._write_status(job)
 
     # -- create ------------------------------------------------------------

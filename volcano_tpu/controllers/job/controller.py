@@ -51,14 +51,25 @@ class JobController:
         self._threads: List[threading.Thread] = []
         self._plugin_cache = {}
 
-        store.watch("Job", WatchHandler(
-            added=self._add_job, updated=self._update_job,
-            deleted=self._delete_job))
-        store.watch("Pod", WatchHandler(
-            added=self._add_pod, updated=self._update_pod,
-            deleted=self._delete_pod))
-        store.watch("Command", WatchHandler(added=self._add_command))
-        store.watch("PodGroup", WatchHandler(updated=self._update_pod_group))
+        self._watch_regs = [
+            ("Job", WatchHandler(
+                added=self._add_job, updated=self._update_job,
+                deleted=self._delete_job)),
+            ("Pod", WatchHandler(
+                added=self._add_pod, updated=self._update_pod,
+                deleted=self._delete_pod)),
+            ("Command", WatchHandler(added=self._add_command)),
+            ("PodGroup", WatchHandler(updated=self._update_pod_group)),
+        ]
+        for kind, handler in self._watch_regs:
+            store.watch(kind, handler)
+
+    def detach(self) -> None:
+        """Unregister store watches (sim restart-injection / teardown) so a
+        replacement controller can take over the same store."""
+        for kind, handler in self._watch_regs:
+            self.store.unwatch(kind, handler)
+        self._watch_regs = []
 
     # -- plugins -----------------------------------------------------------
 

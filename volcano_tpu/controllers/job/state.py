@@ -30,7 +30,9 @@ def total_tasks(job: objects.Job) -> int:
 
 
 def _now_transition(status: objects.JobStatus) -> None:
-    status.state.last_transition_time = time.time()
+    from volcano_tpu.utils import clock
+
+    status.state.last_transition_time = clock.now()
 
 
 class _State:

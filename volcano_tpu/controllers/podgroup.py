@@ -21,7 +21,15 @@ class PodGroupController:
         self.store = store
         self.scheduler_name = scheduler_name
         self._queue: deque = deque()
-        store.watch("Pod", WatchHandler(added=self._add_pod))
+        self._watch_regs = [("Pod", WatchHandler(added=self._add_pod))]
+        for kind, handler in self._watch_regs:
+            store.watch(kind, handler)
+
+    def detach(self) -> None:
+        """Unregister store watches (sim restart-injection / teardown)."""
+        for kind, handler in self._watch_regs:
+            self.store.unwatch(kind, handler)
+        self._watch_regs = []
 
     def _add_pod(self, pod: objects.Pod) -> None:
         if pod.spec.scheduler_name != self.scheduler_name:

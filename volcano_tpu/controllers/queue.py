@@ -23,11 +23,21 @@ class QueueController:
         # queue_controller.go:38-48)
         self._pod_groups: Dict[str, Set[str]] = {}
         self._queue: deque = deque()
-        store.watch("Queue", WatchHandler(added=self._add_queue,
-                                          deleted=self._delete_queue))
-        store.watch("PodGroup", WatchHandler(
-            added=self._add_pg, updated=self._update_pg,
-            deleted=self._delete_pg))
+        self._watch_regs = [
+            ("Queue", WatchHandler(added=self._add_queue,
+                                   deleted=self._delete_queue)),
+            ("PodGroup", WatchHandler(
+                added=self._add_pg, updated=self._update_pg,
+                deleted=self._delete_pg)),
+        ]
+        for kind, handler in self._watch_regs:
+            store.watch(kind, handler)
+
+    def detach(self) -> None:
+        """Unregister store watches (sim restart-injection / teardown)."""
+        for kind, handler in self._watch_regs:
+            self.store.unwatch(kind, handler)
+        self._watch_regs = []
 
     # -- handlers ----------------------------------------------------------
 

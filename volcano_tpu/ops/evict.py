@@ -331,6 +331,32 @@ def _prop_verdict(enc, st, claimees):
     return out, under
 
 
+def _gang_verdict(enc, st, claimees):
+    """gang.go:82-86: per-job occupancy budget decremented per NOMINATED
+    victim within one call — at most (ready - minAvailable) victims per
+    gang per node row; minAvailable == 1 gangs are unbudgeted. Walked in
+    claimee order like the serial fn (victimview._gang_mask twin)."""
+    jv = enc["vic_job"]
+    v_width = jv.shape[1]
+    min_av = enc["job_min_av"][jv]                       # [N, V]
+    budget0 = jnp.maximum(st["ready"][jv] - min_av, 0)
+
+    def body(v, carry):
+        used, out = carry
+        a = claimees[:, v]
+        allow = (min_av[:, v] == 1) | (used[:, v] < budget0[:, v])
+        nominate = a & allow
+        out = out.at[:, v].set(nominate)
+        upd = nominate[:, None] & enc["vic_samejob"][:, v, :]
+        used = jnp.where(upd, used + 1, used)
+        return used, out
+
+    _, out = lax.fori_loop(
+        0, v_width, body,
+        (jnp.zeros(jv.shape, jnp.int32), jnp.zeros(jv.shape, bool)))
+    return out
+
+
 def _victim_masks(spec: EvictSpec, enc, st, claimees, claimer_job,
                   claimer_req):
     """Deciding-tier intersection over the [N, V] claimee mask — each fn
@@ -341,11 +367,7 @@ def _victim_masks(spec: EvictSpec, enc, st, claimees, claimer_job,
     under = jnp.zeros(n, bool)
     for name in spec.victim_fns:
         if name == "gang":
-            jv = enc["vic_job"]
-            occ = st["ready"][jv]
-            gm = (enc["job_min_av"][jv] <= occ - 1) \
-                | (enc["job_min_av"][jv] == 1)
-            m = m & gm
+            m = m & _gang_verdict(enc, st, claimees)
         elif name == "conformance":
             m = m & enc["vic_conf"]
         elif name == "drf":
@@ -1350,7 +1372,7 @@ class _EvictPlan:
             arrays["rr0"] = np.int32(helper._last_processed_node_index)
             arrays["num_to_find"] = np.int32(
                 helper.calculate_num_of_feasible_nodes_to_find(n))
-        if "drf" in decide:
+        if "drf" in decide or "gang" in decide:
             vj = np.where(vic_valid, vic_job, -1 - np.arange(v)[None, :])
             arrays["vic_samejob"] = vj[:, :, None] == vj[:, None, :]
         if "proportion" in decide:

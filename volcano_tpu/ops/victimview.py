@@ -150,23 +150,31 @@ class VictimSelector:
         return [c for c, ok in zip(claimees, mask) if ok]
 
     def _gang_mask(self, claimees) -> np.ndarray:
-        """gang.go:82-86: victim only if its gang stays intact — evaluated
-        per victim against the job's CURRENT occupancy, as the serial fn
-        does (ready_task_num is memoized on the job's status version)."""
+        """gang.go:82-86: victim only while its gang stays intact — a
+        per-job occupancy budget decremented per nominated victim, so one
+        call nominates at most (ready - minAvailable) victims per gang
+        (minAvailable == 1 gangs are unbudgeted, as in the serial fn)."""
         jobs = self.ssn.jobs
-        memo = {}
+        budget = {}
         out = np.empty(len(claimees), bool)
         for i, c in enumerate(claimees):
-            ok = memo.get(c.job)
-            if ok is None:
+            state = budget.get(c.job)
+            if state is None:
                 job = jobs.get(c.job)
                 if job is None:
-                    ok = False
+                    state = (0, False)
                 else:
-                    ok = (job.min_available <= job.ready_task_num() - 1
-                          or job.min_available == 1)
-                memo[c.job] = ok
-            out[i] = ok
+                    state = (job.ready_task_num() - job.min_available,
+                             job.min_available == 1)
+            remaining, unbudgeted = state
+            if unbudgeted:
+                out[i] = True
+            elif remaining > 0:
+                out[i] = True
+                remaining -= 1
+            else:
+                out[i] = False
+            budget[c.job] = (remaining, unbudgeted)
         return out
 
     def _conformance_mask(self, claimees) -> np.ndarray:

@@ -87,9 +87,9 @@ class DefaultEvictor:
         self.store = store
 
     def evict(self, pod: objects.Pod, reason: str = "") -> None:
-        import time as _time
+        from volcano_tpu.utils import clock
 
-        pod.metadata.deletion_timestamp = _time.time()
+        pod.metadata.deletion_timestamp = clock.now()
         self.store.update(pod)
 
 
@@ -313,13 +313,28 @@ class SchedulerCache:
             return
         self._watching = True
         s = self.store
-        s.watch("Pod", WatchHandler(self.add_pod, self.update_pod_from_watch, self.delete_pod))
-        s.watch("Node", WatchHandler(self.add_node, self.update_node_from_watch, self.delete_node))
-        s.watch("PodGroup", WatchHandler(self.add_pod_group, self.update_pod_group_from_watch, self.delete_pod_group))
-        s.watch("Queue", WatchHandler(self.add_queue, self.update_queue_from_watch, self.delete_queue))
-        s.watch("PriorityClass", WatchHandler(self.add_priority_class, self.update_priority_class_from_watch, self.delete_priority_class))
-        s.watch("ResourceQuota", WatchHandler(self.add_resource_quota, self.update_resource_quota_from_watch, self.delete_resource_quota))
-        s.watch("PodDisruptionBudget", WatchHandler(self.add_pdb, self.update_pdb_from_watch, self.delete_pdb))
+        self._watch_regs = [
+            ("Pod", WatchHandler(self.add_pod, self.update_pod_from_watch, self.delete_pod)),
+            ("Node", WatchHandler(self.add_node, self.update_node_from_watch, self.delete_node)),
+            ("PodGroup", WatchHandler(self.add_pod_group, self.update_pod_group_from_watch, self.delete_pod_group)),
+            ("Queue", WatchHandler(self.add_queue, self.update_queue_from_watch, self.delete_queue)),
+            ("PriorityClass", WatchHandler(self.add_priority_class, self.update_priority_class_from_watch, self.delete_priority_class)),
+            ("ResourceQuota", WatchHandler(self.add_resource_quota, self.update_resource_quota_from_watch, self.delete_resource_quota)),
+            ("PodDisruptionBudget", WatchHandler(self.add_pdb, self.update_pdb_from_watch, self.delete_pdb)),
+        ]
+        for kind, handler in self._watch_regs:
+            s.watch(kind, handler)
+
+    def detach_watches(self) -> None:
+        """Unregister this cache's store watches (sim restart-injection /
+        teardown): a replacement cache can then run() against the same
+        store without the old cache double-mirroring every write."""
+        if self.store is None or not getattr(self, "_watching", False):
+            return
+        for kind, handler in getattr(self, "_watch_regs", []):
+            self.store.unwatch(kind, handler)
+        self._watch_regs = []
+        self._watching = False
 
     def wait_for_cache_sync(self) -> bool:
         return True  # synchronous watches are always synced

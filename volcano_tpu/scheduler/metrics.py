@@ -62,6 +62,31 @@ class Counter:
             return self._data.get(labels, 0.0)
 
 
+class Gauge:
+    """A set-to-current-value metric (pending pods, queue depth): unlike a
+    Counter it can move both ways, so scrapers read the instantaneous
+    level instead of a monotone total."""
+
+    def __init__(self, name: str, help_: str, label_names=()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._data: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, labels: Tuple[str, ...] = ()) -> None:
+        with self._lock:
+            self._data[labels] = float(value)
+
+    def inc(self, labels: Tuple[str, ...] = (), value: float = 1.0) -> None:
+        with self._lock:
+            self._data[labels] = self._data.get(labels, 0.0) + value
+
+    def get(self, labels: Tuple[str, ...] = ()) -> float:
+        with self._lock:
+            return self._data.get(labels, 0.0)
+
+
 class Registry:
     def __init__(self):
         ms = [0.005 * (2**k) for k in range(10)]  # 5ms..~5s, in seconds
@@ -91,6 +116,16 @@ class Registry:
             f"{_NAMESPACE}_unschedule_job_count", "Number of unschedulable jobs")
         self.job_retry_counts = Counter(
             f"{_NAMESPACE}_job_retry_counts", "Job retries", ("job_id",))
+        # instantaneous cluster levels (set each cycle; the sim harness and
+        # the scheduler loop both publish through these)
+        self.pending_pods = Gauge(
+            f"{_NAMESPACE}_pending_pods", "Pods currently awaiting placement")
+        self.queue_depth = Gauge(
+            f"{_NAMESPACE}_queue_depth",
+            "PodGroups currently pending or inqueue, per queue", ("queue",))
+        self.sessions_run = Gauge(
+            f"{_NAMESPACE}_sessions_run",
+            "Scheduler sessions completed since process start")
 
 
 _registry: Optional[Registry] = None
@@ -160,6 +195,18 @@ def register_job_retry(job_id: str) -> None:
     registry().job_retry_counts.inc((job_id,))
 
 
+def set_pending_pods(n: int) -> None:
+    registry().pending_pods.set(n)
+
+
+def set_queue_depth(queue: str, n: int) -> None:
+    registry().queue_depth.set(n, (queue,))
+
+
+def set_sessions_run(n: int) -> None:
+    registry().sessions_run.set(n)
+
+
 # -- exposition -------------------------------------------------------------
 
 
@@ -176,6 +223,10 @@ def render() -> str:
                 le = f'le="{b}"'
                 full = ",".join(x for x in (label_str, le) if x)
                 lines.append(f"{h.name}_bucket{{{full}}} {c}")
+            # the +Inf bucket is mandatory in the exposition format (its
+            # value == _count); scrapers compute quantiles from it
+            inf = ",".join(x for x in (label_str, 'le="+Inf"') if x)
+            lines.append(f"{h.name}_bucket{{{inf}}} {n}")
             suffix = f"{{{label_str}}}" if label_str else ""
             lines.append(f"{h.name}_sum{suffix} {total}")
             lines.append(f"{h.name}_count{suffix} {n}")
@@ -190,4 +241,12 @@ def render() -> str:
                 label_str = ",".join(f'{k}="{v2}"' for k, v2 in zip(c.label_names, labels))
                 suffix = f"{{{label_str}}}" if label_str else ""
                 lines.append(f"{c.name}{suffix} {v}")
+    for g in (r.pending_pods, r.queue_depth, r.sessions_run):
+        lines.append(f"# HELP {g.name} {g.help}")
+        lines.append(f"# TYPE {g.name} gauge")
+        with g._lock:
+            for labels, v in g._data.items():
+                label_str = ",".join(f'{k}="{v2}"' for k, v2 in zip(g.label_names, labels))
+                suffix = f"{{{label_str}}}" if label_str else ""
+                lines.append(f"{g.name}{suffix} {v}")
     return "\n".join(lines) + "\n"

@@ -12,6 +12,7 @@ import time
 from typing import List
 
 from volcano_tpu.api import objects
+from volcano_tpu.utils import clock
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.types import TaskStatus, ValidateResult
 from volcano_tpu.api.unschedule_info import FitErrors
@@ -50,14 +51,23 @@ class GangPlugin(Plugin):
 
         def preemptable_fn(preemptor: TaskInfo, preemptees: List[TaskInfo]) -> List[TaskInfo]:
             victims = []
+            # per-job occupancy map, DECREMENTED per nominated victim
+            # (gang.go:82-86): one call may nominate at most
+            # (ready - minAvailable) victims per gang — a static read
+            # would let a single reclaim pass shred a gang below its min,
+            # the partial-gang bug the sim auditor catches mechanically
+            occupied_map = {}
             for preemptee in preemptees:
                 job = ssn.jobs.get(preemptee.job)
                 if job is None:
                     continue
-                occupied = job.ready_task_num()
-                # victim only if its gang stays intact (gang.go:82-86)
+                occupied = occupied_map.get(job.uid)
+                if occupied is None:
+                    occupied = job.ready_task_num()
                 if job.min_available <= occupied - 1 or job.min_available == 1:
                     victims.append(preemptee)
+                    occupied -= 1
+                occupied_map[job.uid] = occupied
             return victims
 
         ssn.add_reclaimable_fn(PLUGIN_NAME, preemptable_fn)
@@ -97,7 +107,7 @@ class GangPlugin(Plugin):
             jc = objects.PodGroupCondition(
                 type=objects.POD_GROUP_UNSCHEDULABLE_TYPE,
                 status="True",
-                last_transition_time=time.time(),
+                last_transition_time=clock.now(),
                 transition_id=ssn.uid,
                 reason=objects.NOT_ENOUGH_RESOURCES_REASON,
                 message=msg,

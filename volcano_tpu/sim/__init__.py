@@ -1,0 +1,23 @@
+"""volcano_tpu.sim — deterministic virtual-time cluster simulator.
+
+Runs the REAL stack — store + admission + controllers + scheduler cache +
+sessions (incl. the TPU solve path) — against a simulated cluster driven
+by a priority-queue event loop in virtual time: scenario-file workload
+generation (arrival storms, gang jobs, lifecycles), fault injection on the
+store/watch seams (journal overflow + reset storms, node flaps, component
+restarts mid-defer-window), and a continuous invariant auditor that dumps
+a repro bundle on violation.
+
+Determinism contract: all scheduling-relevant time flows through the
+virtual clock (utils/clock.py seam), all randomness through named seeded
+RNG streams, and the event log hashes every decision — same scenario +
+same seed ⇒ byte-identical event-log hash and audit summary.
+
+Entry point: ``python -m volcano_tpu.sim run <scenario.yaml> --seed 7``
+(docs/DESIGN.md §12).
+"""
+
+from volcano_tpu.sim.clock import RngStreams, VirtualClock  # noqa: F401
+from volcano_tpu.sim.engine import SimEngine  # noqa: F401
+from volcano_tpu.sim.harness import SimCluster  # noqa: F401
+from volcano_tpu.sim.workload import load_scenario, scale_scenario  # noqa: F401

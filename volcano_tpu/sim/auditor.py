@@ -1,0 +1,354 @@
+"""Continuous invariant auditing over the simulated cluster.
+
+After every session (configurable cadence) the auditor checks the store
+(simulated ground truth), the scheduler cache, the journal mirrors, and
+the metrics registry against each other:
+
+- ``node_overcommit``   — per node, the requests of its live bound pods
+                          fit inside allocatable (store-level truth);
+- ``cache_accounting``  — every cache NodeInfo's used/idle equals the sum
+                          over its resident tasks (the stale-state
+                          detector for the fused bulk-apply paths);
+- ``gang_atomicity``    — a gang with any bound pod and no terminated pod
+                          has at least min_member bound (no half-placed
+                          gangs can ever be observable between sessions);
+- ``phantom_cache``     — the cache's pod population equals the store's
+                          (no phantom tasks, no lost deletes), node and
+                          queue sets match;
+- ``mirror_consistency``— each journal mirror, once drained fault-free,
+                          matches the store exactly (the watch-reset /
+                          ring-overflow convergence contract);
+- ``event_consistency`` — Scheduled events recorded == binds performed,
+                          preemption-victim metrics == evictions
+                          performed;
+- ``fair_share``        — optional bounded-drift check between weighted
+                          queues (only meaningful under reclaim-enabled
+                          scenarios; off by default).
+
+A violation dumps a minimized repro bundle (scenario + seed + virtual
+time + offending objects + the event-log tail) under the run's repro
+directory, so a failing soak reproduces with one CLI command.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.job_info import new_task_info
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.scheduler import metrics
+
+
+@dataclass
+class Violation:
+    invariant: str
+    subject: str
+    message: str
+    detail: Dict = field(default_factory=dict)
+
+    def to_dict(self) -> Dict:
+        return {"invariant": self.invariant, "subject": self.subject,
+                "message": self.message, "detail": self.detail}
+
+
+_EPS_CPU = 1e-6
+_EPS_MEM = 1e-3
+_TERMINAL = (objects.POD_PHASE_SUCCEEDED, objects.POD_PHASE_FAILED)
+
+
+def _res_close(a: Resource, b: Resource) -> bool:
+    if abs(a.milli_cpu - b.milli_cpu) > _EPS_CPU:
+        return False
+    if abs(a.memory - b.memory) > _EPS_MEM:
+        return False
+    names = set(a.scalar_resources or {}) | set(b.scalar_resources or {})
+    for name in sorted(names):
+        av = (a.scalar_resources or {}).get(name, 0.0)
+        bv = (b.scalar_resources or {}).get(name, 0.0)
+        if abs(av - bv) > _EPS_CPU:
+            return False
+    return True
+
+
+class Auditor:
+    def __init__(self, sim, cfg: Dict):
+        self.sim = sim
+        self.cfg = cfg or {}
+        self.checks_run = 0
+        self.violations: List[Violation] = []
+
+    # -- entry -------------------------------------------------------------
+
+    def audit(self, session: int) -> List[Violation]:
+        found: List[Violation] = []
+        found.extend(self._check_overcommit())
+        found.extend(self._check_cache_accounting())
+        found.extend(self._check_gang_atomicity())
+        found.extend(self._check_phantom_cache())
+        found.extend(self._check_mirrors())
+        found.extend(self._check_event_consistency())
+        if self.cfg.get("fair_share"):
+            found.extend(self._check_fair_share())
+        self.checks_run += 1
+        if found:
+            self.violations.extend(found)
+            self._dump_repro(session, found)
+        return found
+
+    # -- invariants --------------------------------------------------------
+
+    def _live_bound_pods(self) -> Dict[str, List[objects.Pod]]:
+        by_node: Dict[str, List[objects.Pod]] = {}
+        for pod in self.sim.store.list("Pod"):
+            if not pod.spec.node_name or pod.status.phase in _TERMINAL:
+                continue
+            by_node.setdefault(pod.spec.node_name, []).append(pod)
+        return by_node
+
+    def _check_overcommit(self) -> List[Violation]:
+        out: List[Violation] = []
+        by_node = self._live_bound_pods()
+        for node in self.sim.store.list("Node"):
+            name = node.metadata.name
+            alloc = Resource.from_resource_list(node.status.allocatable)
+            used = Resource.empty()
+            for pod in by_node.get(name, []):
+                used.add(new_task_info(pod).resreq)
+            if not used.less_equal(alloc):
+                out.append(Violation(
+                    "node_overcommit", name,
+                    f"bound pod requests exceed allocatable on {name}",
+                    {"used_milli_cpu": used.milli_cpu,
+                     "alloc_milli_cpu": alloc.milli_cpu,
+                     "used_memory": used.memory,
+                     "alloc_memory": alloc.memory,
+                     "pods": sorted(
+                         f"{p.metadata.namespace}/{p.metadata.name}"
+                         for p in by_node.get(name, []))}))
+        return out
+
+    def _check_cache_accounting(self) -> List[Violation]:
+        out: List[Violation] = []
+        cache = self.sim.cache
+        cache.flush_mirror()
+        for name in sorted(cache.nodes):
+            node = cache.nodes[name]
+            if node.node is None:
+                continue  # placeholder for tasks on an unseen/flapped node
+            used = Resource.empty()
+            for key in sorted(node.tasks):
+                used.add(node.tasks[key].resreq)
+            if not _res_close(node.used, used):
+                out.append(Violation(
+                    "cache_accounting", name,
+                    f"NodeInfo.used diverged from sum-over-tasks on {name}",
+                    {"used_milli_cpu": node.used.milli_cpu,
+                     "sum_milli_cpu": used.milli_cpu,
+                     "used_memory": node.used.memory,
+                     "sum_memory": used.memory,
+                     "tasks": sorted(node.tasks)}))
+            expect_idle = node.allocatable.clone().sub(used)
+            if not _res_close(node.idle, expect_idle):
+                out.append(Violation(
+                    "cache_accounting", name,
+                    f"NodeInfo.idle diverged from allocatable - used on {name}",
+                    {"idle_milli_cpu": node.idle.milli_cpu,
+                     "expect_milli_cpu": expect_idle.milli_cpu}))
+        return out
+
+    def _check_gang_atomicity(self) -> List[Violation]:
+        out: List[Violation] = []
+        pods_by_group: Dict[str, List[objects.Pod]] = {}
+        for pod in self.sim.store.list("Pod"):
+            group = pod.metadata.annotations.get(
+                objects.GROUP_NAME_ANNOTATION_KEY)
+            if group:
+                key = f"{pod.metadata.namespace}/{group}"
+                pods_by_group.setdefault(key, []).append(pod)
+        for pg in self.sim.store.list("PodGroup"):
+            key = f"{pg.metadata.namespace}/{pg.metadata.name}"
+            pods = pods_by_group.get(key, [])
+            terminated = sum(1 for p in pods if p.status.phase in _TERMINAL)
+            bound = sum(1 for p in pods
+                        if p.spec.node_name
+                        and p.status.phase not in _TERMINAL)
+            if terminated == 0 and 0 < bound < pg.spec.min_member:
+                out.append(Violation(
+                    "gang_atomicity", key,
+                    f"gang {key} partially bound: {bound} < "
+                    f"minMember {pg.spec.min_member}",
+                    {"bound": bound, "min_member": pg.spec.min_member,
+                     "pods": sorted(
+                         f"{p.metadata.namespace}/{p.metadata.name}"
+                         for p in pods)}))
+        return out
+
+    def _check_phantom_cache(self) -> List[Violation]:
+        out: List[Violation] = []
+        cache = self.sim.cache
+        cache.flush_mirror()
+        store_pods = set()
+        for pod in self.sim.store.list("Pod"):
+            if cache._responsible_for(pod):
+                store_pods.add(f"{pod.metadata.namespace}/{pod.metadata.name}")
+        cache_pods = set()
+        for job_id in sorted(cache.jobs):
+            job = cache.jobs[job_id]
+            for uid in sorted(job.tasks):
+                ti = job.tasks[uid]
+                cache_pods.add(f"{ti.namespace}/{ti.name}")
+        phantom = sorted(cache_pods - store_pods)
+        missing = sorted(store_pods - cache_pods)
+        if phantom or missing:
+            out.append(Violation(
+                "phantom_cache", "cache-vs-store",
+                f"cache/store pod sets diverged: {len(phantom)} phantom, "
+                f"{len(missing)} missing",
+                {"phantom": phantom[:20], "missing": missing[:20]}))
+        store_nodes = sorted(
+            n.metadata.name for n in self.sim.store.list("Node"))
+        cache_nodes = sorted(n for n in cache.nodes
+                             if cache.nodes[n].node is not None)
+        if store_nodes != cache_nodes:
+            only_cache = sorted(set(cache_nodes) - set(store_nodes))
+            only_store = sorted(set(store_nodes) - set(cache_nodes))
+            out.append(Violation(
+                "phantom_cache", "nodes",
+                "cache/store node sets diverged",
+                {"only_cache": only_cache[:20],
+                 "only_store": only_store[:20]}))
+        return out
+
+    def _check_mirrors(self) -> List[Violation]:
+        out: List[Violation] = []
+        for mirror in self.sim.mirrors:
+            mirror.catch_up()
+            diff = mirror.diff_vs_store()
+            if diff["phantom"] or diff["missing"] or diff["stale"]:
+                out.append(Violation(
+                    "mirror_consistency", mirror.kind,
+                    f"mirror[{mirror.kind}] did not converge to the store "
+                    f"after catch-up",
+                    {k: v[:20] for k, v in diff.items()}))
+        return out
+
+    def _check_event_consistency(self) -> List[Violation]:
+        out: List[Violation] = []
+        scheduled = sum(
+            1 for e in self.sim.store.events
+            if e.reason == "Scheduled" and e.event_type == "Normal")
+        binds = self.sim.counters["binds"]
+        if scheduled != binds:
+            out.append(Violation(
+                "event_consistency", "scheduled-events",
+                f"{scheduled} Scheduled events recorded vs {binds} binds "
+                f"performed",
+                {"scheduled_events": scheduled, "binds": binds}))
+        evict_events = sum(
+            1 for e in self.sim.store.events
+            if e.reason == "Evict" and e.event_type == "Normal")
+        evictions = self.sim.counters["evictions"]
+        if evict_events != evictions:
+            out.append(Violation(
+                "event_consistency", "evict-events",
+                f"{evict_events} Evict events recorded vs {evictions} "
+                f"evictions performed",
+                {"evict_events": evict_events, "evictions": evictions}))
+        # the preemption-victims metric counts SELECTED victims (the
+        # reference's preempt.go:222 semantics) and reclaim evicts without
+        # touching it, so it bounds nothing — sanity-check only that it
+        # never goes negative-shaped (a float accumulator corruption)
+        victims = metrics.registry().preemption_victims.get()
+        if victims < 0:
+            out.append(Violation(
+                "event_consistency", "preemption-victims",
+                f"preemption-victim metric is negative: {victims}",
+                {"metric_victims": victims}))
+        return out
+
+    def _check_fair_share(self) -> List[Violation]:
+        """Bounded drift between weighted queues that BOTH have pending
+        demand: the queue with the larger weight-normalized allocation may
+        not exceed the smaller by more than tolerance x cluster capacity.
+        Generous by construction — proportional shares converge over
+        sessions, not instantly."""
+        out: List[Violation] = []
+        tolerance = float(self.cfg.get("fair_share_tolerance", 0.5))
+        total_cpu = sum(
+            Resource.from_resource_list(n.status.allocatable).milli_cpu
+            for n in self.sim.store.list("Node"))
+        if total_cpu <= 0:
+            return out
+        queue_of_group: Dict[str, str] = {}
+        for pg in self.sim.store.list("PodGroup"):
+            key = f"{pg.metadata.namespace}/{pg.metadata.name}"
+            queue_of_group[key] = pg.spec.queue or "default"
+        alloc: Dict[str, float] = {}
+        pending: Dict[str, int] = {}
+        for pod in self.sim.store.list("Pod"):
+            group = pod.metadata.annotations.get(
+                objects.GROUP_NAME_ANNOTATION_KEY)
+            if not group:
+                continue
+            queue = queue_of_group.get(
+                f"{pod.metadata.namespace}/{group}", "default")
+            if pod.status.phase in _TERMINAL:
+                continue
+            req = new_task_info(pod).resreq.milli_cpu
+            if pod.spec.node_name:
+                alloc[queue] = alloc.get(queue, 0.0) + req
+            else:
+                pending[queue] = pending.get(queue, 0) + 1
+        weights = {q["name"]: float(q.get("weight", 1))
+                   for q in self.sim.cfg["queues"]}
+        starved = sorted(q for q in pending if pending.get(q, 0) > 0)
+        for ql in starved:
+            for qr in starved:
+                if ql >= qr:
+                    continue
+                wl, wr = weights.get(ql, 1.0), weights.get(qr, 1.0)
+                drift = alloc.get(ql, 0.0) / wl - alloc.get(qr, 0.0) / wr
+                if abs(drift) > tolerance * total_cpu:
+                    out.append(Violation(
+                        "fair_share", f"{ql}-vs-{qr}",
+                        f"weight-normalized allocation drift between "
+                        f"{ql} and {qr} exceeds bound",
+                        {"drift_milli_cpu": drift,
+                         "tolerance_milli_cpu": tolerance * total_cpu}))
+        return out
+
+    # -- repro bundles -----------------------------------------------------
+
+    def _dump_repro(self, session: int, found: List[Violation]) -> None:
+        repro_dir = self.sim.repro_dir
+        if not repro_dir:
+            return
+        os.makedirs(repro_dir, exist_ok=True)
+        bundle = {
+            "scenario": {k: v for k, v in self.sim.cfg.items()
+                         if not k.startswith("_")},
+            "scenario_path": self.sim.cfg.get("_path"),
+            "seed": self.sim.seed,
+            "scale": self.sim.cfg.get("_scale", 1.0),
+            "virtual_time_s": self.sim.vclock.now(),
+            "session": session,
+            "violations": [v.to_dict() for v in found],
+            "event_log_tail": self.sim.engine.log_tail(200),
+            "repro_command": (
+                f"python -m volcano_tpu.sim run "
+                f"{self.sim.cfg.get('_path', '<scenario>')} "
+                f"--seed {self.sim.seed} "
+                f"--scale {self.sim.cfg.get('_scale', 1.0)}"),
+        }
+        path = os.path.join(
+            repro_dir, f"violation-s{session:05d}.json")
+        with open(path, "w") as fh:
+            json.dump(bundle, fh, indent=1, default=str)
+        self.sim.engine.log_event(
+            "audit-violation",
+            f"session={session} n={len(found)} "
+            f"kinds={sorted({v.invariant for v in found})}")

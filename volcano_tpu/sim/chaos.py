@@ -1,0 +1,195 @@
+"""Fault injection on the store/watch/component seams.
+
+Each fault family runs as an independent event stream on the engine with
+its own RNG stream, so enabling one never perturbs another's draws:
+
+- ``node_flap``       — delete a node, fail its running pods (kubelet-lost
+                        semantics), re-add the same shape after ``down_s``;
+- ``reset_storm``     — a burst of no-op pod updates that floods every
+                        watch journal past its ring cap, forcing the
+                        mirror consumers through the reset/re-list path;
+- ``mirror_lag``      — per-drain skip probability (a consumer that lags
+                        past the ring) and per-poll error probability
+                        (gateway 5xx / lost response) applied to the
+                        JournalMirrors;
+- ``restart_scheduler`` / ``restart_controllers`` — tear the component
+  down (detach its store watches) and rebuild it from a fresh list+watch
+  replay, the crash-recovery path;
+- ``kill_session``    — abandon a session between its actions and its
+  close (the mirror-flush defer window) and restart the scheduler: the
+  crash point where stale-cache accounting bugs historically lived;
+- ``seeded_bug``      — a deliberately reintroduced corruption (the
+  auditor's self-test fixture): ``accounting_leak`` re-adds an evicted
+  task's request to a node's ``used`` (the evict-without-release bug
+  class), ``phantom_pod`` inserts a cache task with no store object
+  behind it (the watch-reset phantom bug class).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict
+
+from volcano_tpu.api import objects
+
+
+class ChaosInjector:
+    def __init__(self, sim, cfg: Dict, rngs):
+        self.sim = sim
+        self.cfg = cfg or {}
+        self.rngs = rngs
+        self.counts: Dict[str, int] = {}
+        # node name -> node spec awaiting re-add
+        self._down_nodes: Dict[str, objects.Node] = {}
+
+    def _bump(self, fault: str) -> None:
+        self.counts[fault] = self.counts.get(fault, 0) + 1
+
+    # -- wiring ------------------------------------------------------------
+
+    def start(self) -> None:
+        for fault in ("node_flap", "reset_storm", "restart_scheduler",
+                      "restart_controllers"):
+            rate = float(self.cfg.get(fault, {}).get("rate_per_s", 0.0))
+            if rate > 0:
+                self._schedule(fault, rate)
+        bug = self.cfg.get("seeded_bug")
+        if bug:
+            self.sim.engine.schedule_at(
+                float(bug.get("at_s", 1.0)), "seeded-bug",
+                lambda: self._seeded_bug(bug))
+
+    def _schedule(self, fault: str, rate: float) -> None:
+        rng = self.rngs.stream(f"chaos:{fault}")
+        delay = rng.expovariate(rate)
+        self.sim.engine.schedule_in(
+            delay, f"fault-{fault}",
+            lambda: self._fire(fault, rate))
+
+    def _fire(self, fault: str, rate: float) -> str:
+        detail = getattr(self, f"_do_{fault}")()
+        self._schedule(fault, rate)
+        return detail
+
+    # -- session/mirror seams (read by the harness) ------------------------
+
+    def should_kill_session(self) -> bool:
+        prob = float(self.cfg.get("kill_session", {}).get("prob", 0.0))
+        if not prob:
+            return False
+        return self.rngs.stream("chaos:kill_session").random() < prob
+
+    def mirror_faults(self) -> Dict[str, float]:
+        lag = self.cfg.get("mirror_lag", {})
+        return {"skip_prob": float(lag.get("skip_prob", 0.0)),
+                "error_prob": float(lag.get("error_prob", 0.0))}
+
+    # -- fault actions -----------------------------------------------------
+
+    def _do_node_flap(self) -> str:
+        store = self.sim.store
+        rng = self.rngs.stream("chaos:node_flap")
+        up = sorted(n.metadata.name for n in store.list("Node")
+                    if n.metadata.name not in self._down_nodes)
+        if not up:
+            return "no-node-up"
+        name = rng.choice(up)
+        node = store.delete("Node", "", name)
+        self._down_nodes[name] = node
+        self._bump("node_flap")
+        # kubelet-lost semantics: every live pod on the node dies with it
+        # (bound-but-still-Pending included — leaving them would orphan
+        # binds against a node the scheduler can no longer account)
+        terminal = (objects.POD_PHASE_SUCCEEDED, objects.POD_PHASE_FAILED)
+        failed = 0
+        for pod in store.list("Pod"):
+            if pod.spec.node_name == name \
+                    and pod.status.phase not in terminal:
+                updated = copy.deepcopy(pod)
+                updated.status.phase = objects.POD_PHASE_FAILED
+                updated.status.container_statuses = [
+                    objects.ContainerStatus(name="c", exit_code=137)]
+                store.update_status(updated)
+                failed += 1
+        down_s = float(self.cfg.get("node_flap", {}).get("down_s", 30.0))
+        self.sim.engine.schedule_in(
+            down_s, "node-return", lambda n=name: self._node_return(n))
+        return f"{name} failed_pods={failed}"
+
+    def _node_return(self, name: str) -> str:
+        node = self._down_nodes.pop(name, None)
+        if node is None:
+            return f"{name} already-back"
+        fresh = objects.Node(
+            metadata=objects.ObjectMeta(
+                name=name, labels=dict(node.metadata.labels)),
+            status=objects.NodeStatus(
+                capacity=dict(node.status.capacity),
+                allocatable=dict(node.status.allocatable)))
+        self.sim.store.create(fresh)
+        return name
+
+    def _do_reset_storm(self) -> str:
+        store = self.sim.store
+        rng = self.rngs.stream("chaos:reset_storm")
+        burst = int(self.cfg.get("reset_storm", {}).get("burst", 256))
+        pods = sorted(
+            (p for p in store.list("Pod")
+             if p.metadata.deletion_timestamp is None),
+            key=lambda p: (p.metadata.namespace, p.metadata.name))
+        if not pods:
+            return "no-pods"
+        self._bump("reset_storm")
+        for i in range(burst):
+            pod = pods[rng.randrange(len(pods))]
+            # a fresh read each touch: the same pod may be picked twice
+            live = store.try_get(
+                "Pod", pod.metadata.namespace, pod.metadata.name)
+            if live is None:
+                continue
+            updated = copy.deepcopy(live)
+            updated.metadata.annotations["sim.volcano.sh/storm"] = str(i)
+            store.update(updated)
+        return f"burst={burst} pods={len(pods)}"
+
+    def _do_restart_scheduler(self) -> str:
+        self._bump("restart_scheduler")
+        self.sim.restart_scheduler("chaos")
+        return "scheduler"
+
+    def _do_restart_controllers(self) -> str:
+        self._bump("restart_controllers")
+        self.sim.restart_controllers("chaos")
+        return "controllers"
+
+    # -- seeded bugs (auditor self-test) -----------------------------------
+
+    def _seeded_bug(self, bug: Dict) -> str:
+        kind = bug.get("kind", "accounting_leak")
+        self._bump(f"seeded_bug:{kind}")
+        cache = self.sim.cache
+        if kind == "accounting_leak":
+            # the evict-without-release bug class: a task's request is
+            # double-counted into its node's used/idle, exactly the drift
+            # an unflushed eviction used to leave behind
+            for name in sorted(cache.nodes):
+                node = cache.nodes[name]
+                tasks = sorted(node.tasks)
+                if tasks:
+                    task = node.tasks[tasks[0]]
+                    node.used.add(task.resreq)
+                    node.idle.sub(task.resreq)
+                    return f"accounting_leak node={name}"
+            return "accounting_leak no-target"
+        if kind == "phantom_pod":
+            # the watch-reset phantom bug class: a cache task whose store
+            # object is gone (or never existed)
+            from volcano_tpu.scheduler.util.test_utils import build_pod
+            pod = build_pod(
+                "sim", "phantom-pod-0", "", objects.POD_PHASE_PENDING,
+                {"cpu": "100m", "memory": "64Mi"}, "phantom-group")
+            pod.spec.scheduler_name = "volcano"
+            pod.metadata.ensure_identity()
+            cache.add_pod(pod)
+            return "phantom_pod sim/phantom-pod-0"
+        raise ValueError(f"unknown seeded_bug kind {kind!r}")

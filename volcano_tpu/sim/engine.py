@@ -1,0 +1,84 @@
+"""Event-driven simulation core: a priority-queue loop over virtual time.
+
+Events are (fire_at, seq, name, fn) — seq breaks same-instant ties in
+schedule order, so execution order is a pure function of the schedule
+calls, never of heap internals. Every executed event and every explicit
+``log_event`` feeds a running SHA-256 over ``time|kind|detail`` records:
+the replayable event-log hash the determinism contract binds on (two runs
+of one scenario+seed must produce identical hashes; the hash deliberately
+excludes wall-clock measurements, which live only in the summary).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import itertools
+from typing import Callable, List, Optional, Tuple
+
+from volcano_tpu.sim.clock import VirtualClock
+
+
+class SimEngine:
+    def __init__(self, clock: VirtualClock, log_keep: int = 4096):
+        self.clock = clock
+        self._heap: List[Tuple[float, int, str, Callable]] = []
+        self._seq = itertools.count()
+        self._hash = hashlib.sha256()
+        self.events_run = 0
+        self.log_records = 0
+        # bounded tail of the (hashed) log, kept for repro bundles
+        self._tail: List[str] = []
+        self._log_keep = log_keep
+
+    # -- scheduling --------------------------------------------------------
+
+    def schedule_at(self, at: float, name: str, fn: Callable) -> None:
+        if at < self.clock.now():
+            at = self.clock.now()
+        heapq.heappush(self._heap, (at, next(self._seq), name, fn))
+
+    def schedule_in(self, delay: float, name: str, fn: Callable) -> None:
+        self.schedule_at(self.clock.now() + max(delay, 0.0), name, fn)
+
+    def pending(self) -> int:
+        return len(self._heap)
+
+    # -- event log ---------------------------------------------------------
+
+    def log_event(self, kind: str, detail: str = "") -> None:
+        rec = f"{self.clock.now():.9f}|{kind}|{detail}"
+        self._hash.update(rec.encode())
+        self._hash.update(b"\n")
+        self.log_records += 1
+        self._tail.append(rec)
+        if len(self._tail) > self._log_keep:
+            del self._tail[: len(self._tail) - self._log_keep]
+
+    def log_hash(self) -> str:
+        return self._hash.hexdigest()
+
+    def log_tail(self, n: int = 200) -> List[str]:
+        return self._tail[-n:]
+
+    # -- run ---------------------------------------------------------------
+
+    def run_until(self, t_end: float,
+                  max_events: Optional[int] = None) -> int:
+        """Execute events in (time, seq) order until the queue is drained
+        past ``t_end``. An event fn may return a string — logged as the
+        event's outcome detail; returning None logs just the execution."""
+        ran = 0
+        while self._heap and self._heap[0][0] <= t_end:
+            if max_events is not None and ran >= max_events:
+                break
+            at, _, name, fn = heapq.heappop(self._heap)
+            self.clock.advance(max(at, self.clock.now()))
+            detail = fn()
+            self.log_event(name, detail if isinstance(detail, str) else "")
+            self.events_run += 1
+            ran += 1
+        # land exactly on the horizon so run summaries agree on duration
+        if t_end > self.clock.now():
+            self.clock.advance(t_end)
+        return ran

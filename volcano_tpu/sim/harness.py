@@ -1,0 +1,363 @@
+"""SimCluster — the real stack wired into the virtual-time loop.
+
+One SimCluster owns the same components a production deployment runs —
+Store + admission, job/podgroup/queue controllers, TTL garbage collector,
+kubelet analog, SchedulerCache + session loop (incl. the TPU solve path
+via the tpuscore-gated conf) — and drives them from SimEngine events:
+
+- a *session slice* every ``scheduler.period_s`` virtual seconds runs
+  controllers -> open_session -> actions -> close_session -> controllers
+  -> kubelet -> GC, mirroring Cluster.step()'s convergence order;
+- the workload submits/completes/cancels jobs on its own events;
+- chaos faults fire on theirs (node flaps, reset storms, restarts,
+  mid-defer-window session kills);
+- journal mirrors drain each slice (under chaos lag/error rates) and the
+  auditor checks every invariant at its cadence.
+
+Virtual time is installed as the process-wide stamping clock
+(utils/clock.py) for the duration of ``run()`` — no wall-clock value can
+leak into a scheduling decision — while wall time is still measured
+around each session phase for the latency percentiles in the summary.
+Restarts rebuild a component from a fresh store list+watch replay after
+detaching the old instance's watches: exactly the crash-recovery path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from volcano_tpu import admission
+from volcano_tpu.api import objects
+from volcano_tpu.cluster import Kubelet
+from volcano_tpu.controllers.garbagecollector import GarbageCollector
+from volcano_tpu.controllers.job import JobController
+from volcano_tpu.controllers.podgroup import PodGroupController
+from volcano_tpu.controllers.queue import QueueController
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.cache import SchedulerCache
+from volcano_tpu.scheduler.cache.cache import DefaultBinder, DefaultEvictor
+from volcano_tpu.scheduler.framework import close_session, open_session
+from volcano_tpu.scheduler.scheduler import (
+    DEFAULT_SCHEDULER_CONF,
+    TPU_SCHEDULER_CONF,
+    load_scheduler_conf,
+)
+from volcano_tpu.sim.auditor import Auditor
+from volcano_tpu.sim.chaos import ChaosInjector
+from volcano_tpu.sim.clock import RngStreams, VirtualClock
+from volcano_tpu.sim.engine import SimEngine
+from volcano_tpu.sim.mirror import JournalMirror
+from volcano_tpu.sim.workload import Workload
+from volcano_tpu.store.store import Store
+
+_CONF_BY_NAME = {"tpu": TPU_SCHEDULER_CONF, "default": DEFAULT_SCHEDULER_CONF}
+
+
+class _CountingBinder(DefaultBinder):
+    """DefaultBinder + a shared bind tally (the auditor's event-vs-bind
+    consistency base). Counters live on the sim, so scheduler restarts
+    (fresh binder) keep one continuous series."""
+
+    def __init__(self, store: Store, counters: Dict[str, int]):
+        super().__init__(store)
+        self._counters = counters
+
+    def bind(self, pod, hostname: str) -> None:
+        super().bind(pod, hostname)
+        self._counters["binds"] += 1
+
+
+class _CountingEvictor(DefaultEvictor):
+    def __init__(self, store: Store, counters: Dict[str, int]):
+        super().__init__(store)
+        self._counters = counters
+
+    def evict(self, pod, reason: str = "") -> None:
+        super().evict(pod, reason)
+        self._counters["evictions"] += 1
+
+
+def _percentiles(samples: List[float]) -> Dict[str, float]:
+    if not samples:
+        return {"p50": 0.0, "p90": 0.0, "p99": 0.0, "max": 0.0}
+    ordered = sorted(samples)
+    def pick(q: float) -> float:
+        idx = min(int(q * len(ordered)), len(ordered) - 1)
+        return round(ordered[idx], 3)
+    return {"p50": pick(0.50), "p90": pick(0.90), "p99": pick(0.99),
+            "max": round(ordered[-1], 3)}
+
+
+class SimCluster:
+    def __init__(self, cfg: Dict, seed: int,
+                 repro_dir: Optional[str] = None,
+                 quiet_logs: bool = True):
+        self.cfg = cfg
+        self.seed = int(seed)
+        self.repro_dir = repro_dir
+        # the gated pod-creation path retries by DESIGN (the controller
+        # attempts before enqueue flips the PodGroup), which floods stderr
+        # with expected-path error lines — at cfg5 scale, 200k of them
+        self.quiet_logs = quiet_logs
+        self.vclock = VirtualClock()
+        self.rngs = RngStreams(self.seed)
+        self.engine = SimEngine(self.vclock)
+
+        self.store = Store()
+        admission.install(self.store, "volcano", gate_pods=True)
+        self.counters: Dict[str, int] = {"binds": 0, "evictions": 0}
+        self._build_controllers()
+        self._build_scheduler()
+        self.mirrors = [
+            JournalMirror(self.store, kind, cap=int(cfg["mirrors"]["cap"]))
+            for kind in cfg["mirrors"]["kinds"]]
+
+        self.workload = Workload(self, cfg, self.rngs.stream("workload"))
+        self.chaos = ChaosInjector(self, cfg.get("faults", {}), self.rngs)
+        self.auditor = Auditor(self, cfg.get("audit", {}))
+
+        self.sessions_done = 0
+        self.session_kills = 0
+        self.restarts = {"scheduler": 0, "controllers": 0}
+        self._e2e_ms: List[float] = []
+        self._open_ms: List[float] = []
+        self._actions_ms: List[float] = []
+        self._close_ms: List[float] = []
+        self._session_compiles: List[int] = []
+        self._last_stats: Dict[str, int] = {}
+        self._watcher = None
+        try:
+            from volcano_tpu.utils.jaxcompile import CompileWatcher
+
+            self._watcher = CompileWatcher.install()
+        except Exception:
+            self._watcher = None  # jax-free host: compile accounting absent
+
+    # -- component (re)construction ---------------------------------------
+
+    def _build_controllers(self) -> None:
+        self.job_controller = JobController(self.store)
+        self.podgroup_controller = PodGroupController(self.store, "volcano")
+        self.queue_controller = QueueController(self.store)
+        self.gc = GarbageCollector(self.store, clock=self.vclock.now)
+        self.kubelet = Kubelet(self.store)
+
+    def _build_scheduler(self) -> None:
+        conf_ref = self.cfg["scheduler"]["conf"]
+        conf_str = _CONF_BY_NAME.get(conf_ref, conf_ref)
+        self.actions, self.tiers = load_scheduler_conf(conf_str)
+        self.cache = SchedulerCache(
+            store=self.store,
+            binder=_CountingBinder(self.store, self.counters),
+            evictor=_CountingEvictor(self.store, self.counters))
+        self.cache.run()
+        self.cache.wait_for_cache_sync()
+
+    def restart_scheduler(self, why: str) -> None:
+        """Crash-recover the scheduler: drop the cache (incl. any deferred
+        mirror work — the store is the only durable truth) and rebuild it
+        from a fresh list+watch replay."""
+        self.cache.detach_watches()
+        self._build_scheduler()
+        self.restarts["scheduler"] += 1
+        self.engine.log_event("restart-scheduler", why)
+
+    def restart_controllers(self, why: str) -> None:
+        self.job_controller.detach()
+        self.podgroup_controller.detach()
+        self.queue_controller.detach()
+        self.gc.detach()
+        self._build_controllers()
+        self.restarts["controllers"] += 1
+        self.engine.log_event("restart-controllers", why)
+
+    # -- the session slice -------------------------------------------------
+
+    # process_all's default 10k-iteration runaway guard underestimates a
+    # cfg5-scale backlog (6250 jobs x pods x retries in ONE slice); the
+    # sim bounds runaways with its horizon instead
+    _CONTROLLER_BUDGET = 2_000_000
+
+    def _controllers_step(self) -> None:
+        self.job_controller.process_all(max_iterations=self._CONTROLLER_BUDGET)
+        self.podgroup_controller.process_all()
+        self.queue_controller.process_all()
+
+    def _session_slice(self) -> str:
+        binds_before = self.counters["binds"]
+        evict_before = self.counters["evictions"]
+        self._controllers_step()
+
+        kill = self.chaos.should_kill_session()
+        win = self._watcher.window() if self._watcher is not None else None
+        t0 = time.perf_counter()
+        ssn = open_session(self.cache, self.tiers)
+        t1 = time.perf_counter()
+        for action in self.actions:
+            action.execute(ssn)
+        t2 = time.perf_counter()
+        if kill:
+            # crash inside the defer window: actions ran (binds hit the
+            # store) but the close-time mirror flush / status writeback
+            # never happens — the scheduler restarts from the store
+            self.session_kills += 1
+            self.restart_scheduler("session-kill")
+            t3 = t2
+        else:
+            close_session(ssn)
+            t3 = time.perf_counter()
+        self._open_ms.append((t1 - t0) * 1e3)
+        self._actions_ms.append((t2 - t1) * 1e3)
+        self._close_ms.append((t3 - t2) * 1e3)
+        self._e2e_ms.append((t3 - t0) * 1e3)
+        self._session_compiles.append(
+            win.delta().compiles if win is not None else 0)
+        self.sessions_done += 1
+        metrics.set_sessions_run(self.sessions_done)
+
+        # post-session convergence (Cluster.step order)
+        self.job_controller.process_all(max_iterations=self._CONTROLLER_BUDGET)
+        self.kubelet.step()
+        self.job_controller.process_all(max_iterations=self._CONTROLLER_BUDGET)
+        self.podgroup_controller.process_all()
+        self.queue_controller.process_all()
+        self.gc.process_expired()
+
+        stats = self.workload.on_slice()
+        self._last_stats = stats
+        metrics.set_pending_pods(stats["pending"])
+        self._publish_queue_depth()
+
+        faults = self.chaos.mirror_faults()
+        for mirror in self.mirrors:
+            mirror.drain(
+                rng=self.rngs.stream(f"mirror:{mirror.kind}"),
+                skip_prob=faults["skip_prob"],
+                error_prob=faults["error_prob"])
+
+        every = int(self.cfg["audit"].get("every_sessions", 1) or 0)
+        audit_note = ""
+        if every and self.sessions_done % every == 0:
+            found = self.auditor.audit(self.sessions_done)
+            if found:
+                audit_note = f" AUDIT-VIOLATIONS={len(found)}"
+
+        self._schedule_slice()
+        return (f"n={self.sessions_done} "
+                f"binds+{self.counters['binds'] - binds_before} "
+                f"evict+{self.counters['evictions'] - evict_before} "
+                f"pending={stats['pending']} running={stats['running']} "
+                f"done={stats['succeeded'] + stats['failed']}"
+                f"{' KILLED' if kill else ''}{audit_note}")
+
+    def _publish_queue_depth(self) -> None:
+        depth: Dict[str, int] = {
+            q["name"]: 0 for q in self.cfg["queues"]}
+        gated = (objects.PodGroupPhase.PENDING,
+                 objects.PodGroupPhase.INQUEUE)
+        for pg in self.store.list("PodGroup"):
+            if pg.status.phase in gated:
+                queue = pg.spec.queue or "default"
+                depth[queue] = depth.get(queue, 0) + 1
+        for queue in sorted(depth):
+            metrics.set_queue_depth(queue, depth[queue])
+
+    def _schedule_slice(self) -> None:
+        cap = self.cfg["scheduler"].get("max_sessions")
+        if cap is not None and self.sessions_done >= int(cap):
+            return
+        at = self.vclock.now() + float(self.cfg["scheduler"]["period_s"])
+        if at <= self._horizon + 1e-9:
+            self.engine.schedule_at(at, "session", self._session_slice)
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self, duration: Optional[float] = None) -> Dict:
+        import logging
+
+        from volcano_tpu.scheduler.util import scheduler_helper
+        from volcano_tpu.utils import clock as uclock
+
+        self._horizon = float(duration if duration is not None
+                              else self.cfg["duration_s"])
+        metrics.reset()
+        scheduler_helper.reset_round_robin()
+        uclock.set_source(self.vclock.timestamp)
+        pkg_logger = logging.getLogger("volcano_tpu")
+        prev_level = pkg_logger.level
+        if self.quiet_logs:
+            pkg_logger.setLevel(logging.CRITICAL)
+        wall0 = time.perf_counter()
+        try:
+            self.engine.log_event(
+                "start",
+                f"scenario={self.cfg['name']} seed={self.seed} "
+                f"scale={self.cfg.get('_scale', 1.0)} "
+                f"nodes={self.cfg['cluster']['nodes']} "
+                f"horizon={self._horizon}")
+            self.workload.start()
+            self.chaos.start()
+            self._schedule_slice()
+            self.engine.run_until(self._horizon)
+            self.engine.log_event(
+                "end",
+                f"sessions={self.sessions_done} "
+                f"binds={self.counters['binds']} "
+                f"evictions={self.counters['evictions']} "
+                f"violations={len(self.auditor.violations)}")
+        finally:
+            uclock.set_source(None)
+            pkg_logger.setLevel(prev_level)
+        wall = time.perf_counter() - wall0
+        return self._summary(wall)
+
+    def _summary(self, wall_s: float) -> Dict:
+        warmup = min(3, len(self._session_compiles))
+        jobs = self.workload
+        return {
+            "scenario": self.cfg["name"],
+            "seed": self.seed,
+            "scale": self.cfg.get("_scale", 1.0),
+            "sim_duration_s": round(self.vclock.now(), 3),
+            "wall_s": round(wall_s, 3),
+            "sessions": self.sessions_done,
+            "sessions_per_sec": round(self.sessions_done / wall_s, 3)
+            if wall_s > 0 else 0.0,
+            "session_ms": _percentiles(self._e2e_ms),
+            "phase_ms": {
+                "open": _percentiles(self._open_ms),
+                "actions": _percentiles(self._actions_ms),
+                "close": _percentiles(self._close_ms),
+            },
+            "binds": self.counters["binds"],
+            "evictions": self.counters["evictions"],
+            "session_kills": self.session_kills,
+            "restarts": dict(self.restarts),
+            "jobs": {"submitted": jobs.submitted,
+                     "completed": jobs.completed,
+                     "failed": jobs.failed,
+                     "cancelled": jobs.cancelled},
+            "pods": dict(self._last_stats),
+            "faults": dict(self.chaos.counts),
+            "mirrors": {
+                m.kind: {"resets": m.resets,
+                         "synthesized_deletes": m.synthesized_deletes,
+                         "skipped_drains": m.skipped_drains,
+                         "dropped_polls": m.dropped_polls}
+                for m in self.mirrors},
+            "audit": {
+                "checks": self.auditor.checks_run,
+                "violations": len(self.auditor.violations),
+                "kinds": sorted({v.invariant
+                                 for v in self.auditor.violations}),
+            },
+            "compiles": {
+                "total": sum(self._session_compiles),
+                "after_warmup": sum(self._session_compiles[warmup:]),
+                "per_session": self._session_compiles[:64],
+            },
+            "event_log_hash": self.engine.log_hash(),
+            "log_records": self.engine.log_records,
+            "events_run": self.engine.events_run,
+        }

@@ -1,0 +1,119 @@
+"""JournalMirror — a deterministic local consumer of the gateway's watch
+journal, implementing the reset/re-list protocol.
+
+This is the in-process twin of RemoteStore.watch (store/remote.py): it
+polls a gateway ``_WatchJournal`` ring (store/gateway.py) with a cursor,
+applies delivered events to a mirror map, and on a journal reset —
+overflow of the ring, or a future cursor after a restart — re-lists the
+store and synthesizes DELETED for every previously-known object missing
+from the re-list, so a burst of deletes larger than the ring can never
+leave phantom objects behind. Because polls are synchronous against the
+in-process journal, the whole consumer runs inside the sim's virtual-time
+loop: chaos makes it lag (skipped drains force ring overflow) or fail
+polls (delivered batches dropped without advancing the cursor — the
+at-least-once retry), and the auditor checks that once drained the mirror
+converges to store ground truth (no phantoms, no lost deletes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from volcano_tpu.api import codec
+from volcano_tpu.store.gateway import _WatchJournal
+from volcano_tpu.store.store import Store, object_key
+
+
+class JournalMirror:
+    def __init__(self, store: Store, kind: str, cap: int = 512):
+        self.store = store
+        self.kind = kind
+        self.journal = _WatchJournal(store, kind, cap=cap)
+        self.since = 0
+        # key -> resource_version of the last delivered state
+        self.known: Dict[str, int] = {}
+        self.resets = 0
+        self.delivered = 0
+        self.synthesized_deletes = 0
+        self.dropped_polls = 0
+        self.skipped_drains = 0
+
+    # -- protocol ----------------------------------------------------------
+
+    def _apply(self, events) -> None:
+        for entry in events:
+            etype = entry.get("type")
+            if etype in ("ADDED", "MODIFIED"):
+                obj = codec.from_envelope(entry["object"])
+                self.known[object_key(obj)] = obj.metadata.resource_version
+            elif etype == "DELETED":
+                obj = codec.from_envelope(entry["old"])
+                self.known.pop(object_key(obj), None)
+            self.delivered += 1
+
+    def _relist(self) -> None:
+        listed = {object_key(o): o.metadata.resource_version
+                  for o in self.store.list(self.kind)}
+        for key in sorted(self.known):
+            if key not in listed:
+                # the DELETED-synthesis half of the reset contract: without
+                # it, objects deleted inside the journal gap live forever
+                del self.known[key]
+                self.synthesized_deletes += 1
+        self.known.update(listed)
+        self.resets += 1
+
+    def poll_once(self) -> Tuple[int, bool]:
+        """One non-blocking poll; returns (events_applied, reset_taken)."""
+        events, nxt, reset = self.journal.poll(self.since, 0.0)
+        if reset:
+            self._relist()
+            self.since = nxt
+            return 0, True
+        self._apply(events)
+        self.since = nxt
+        return len(events), False
+
+    def drain(self, rng=None, skip_prob: float = 0.0,
+              error_prob: float = 0.0, max_polls: int = 64) -> int:
+        """Consume until caught up. Chaos seams: with ``skip_prob`` the
+        whole drain is skipped (a lagging consumer — the ring overflows
+        behind it); with ``error_prob`` an individual poll's response is
+        lost BEFORE the cursor advances (gateway 5xx / dropped response),
+        which the protocol absorbs as an at-least-once retry."""
+        if rng is not None and skip_prob and rng.random() < skip_prob:
+            self.skipped_drains += 1
+            return 0
+        applied = 0
+        for _ in range(max_polls):
+            if rng is not None and error_prob and rng.random() < error_prob:
+                self.dropped_polls += 1
+                continue
+            n, reset = self.poll_once()
+            applied += n
+            if n == 0 and not reset:
+                break
+        return applied
+
+    def catch_up(self, max_polls: int = 1024) -> None:
+        """Fault-free drain to quiescence (the auditor's pre-check): the
+        protocol must converge once faults stop."""
+        for _ in range(max_polls):
+            n, reset = self.poll_once()
+            if n == 0 and not reset:
+                return
+        raise RuntimeError(
+            f"mirror[{self.kind}] did not quiesce in {max_polls} polls")
+
+    # -- ground-truth comparison ------------------------------------------
+
+    def diff_vs_store(self) -> Dict[str, list]:
+        """(phantom, missing, stale) key lists vs the store — all empty
+        when the mirror has converged."""
+        truth = {object_key(o): o.metadata.resource_version
+                 for o in self.store.list(self.kind)}
+        phantom = sorted(k for k in self.known if k not in truth)
+        missing = sorted(k for k in truth if k not in self.known)
+        stale = sorted(k for k, v in self.known.items()
+                       if k in truth and truth[k] != v)
+        return {"phantom": phantom, "missing": missing, "stale": stale}

@@ -97,6 +97,15 @@ class _WatchJournal:
                 end = self.start + len(self.events)
                 if since < self.start:
                     return [], end, True  # fell behind the ring: re-list
+                if since > end:
+                    # cursor from a FUTURE sequence this journal never
+                    # assigned (a client that outlived a gateway restart,
+                    # or a corrupted cursor). Waiting for the journal to
+                    # catch up would silently skip every event in the gap
+                    # — the same phantom-object hazard as falling behind —
+                    # so signal the HTTP-410-style reset and make the
+                    # client re-list.
+                    return [], end, True
                 if since < end:
                     return list(self.events[since - self.start:]), end, False
                 if deadline is None:
@@ -123,8 +132,10 @@ class ApiGateway:
     def __init__(self, store: Store, address: str = ":0",
                  token: Optional[str] = None,
                  tls_cert: Optional[str] = None,
-                 tls_key: Optional[str] = None):
+                 tls_key: Optional[str] = None,
+                 journal_cap: int = 4096):
         self.store = store
+        self._journal_cap = journal_cap
         self._address = _parse_address(address, default_host="127.0.0.1")
         self._token = token
         self._tls_cert = tls_cert
@@ -144,7 +155,8 @@ class ApiGateway:
         with self._journals_lock:
             j = self._journals.get(kind)
             if j is None:
-                j = self._journals[kind] = _WatchJournal(self.store, kind)
+                j = self._journals[kind] = _WatchJournal(
+                    self.store, kind, cap=self._journal_cap)
             return j
 
     def start(self) -> "ApiGateway":

@@ -23,12 +23,12 @@ convention). The scheduler cache clones what it needs into its snapshot.
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass, field
 from itertools import repeat
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from volcano_tpu.api import objects
+from volcano_tpu.utils import clock
 
 
 class NotFoundError(KeyError):
@@ -72,7 +72,7 @@ class RecordedEvent:
     event_type: str  # Normal | Warning
     reason: str
     message: str
-    timestamp: float = field(default_factory=time.time)
+    timestamp: float = field(default_factory=lambda: clock.now())
 
 
 class ScheduledEvent:
@@ -245,6 +245,17 @@ class Store:
                 for obj in self._buckets.get(kind, {}).values():
                     handler.added(obj)
 
+    def unwatch(self, kind: str, handler: WatchHandler) -> None:
+        """Remove a registered handler (identity match; unknown handlers
+        are a no-op). A component being torn down — a restarted scheduler
+        cache or controller — detaches so a replacement can watch the same
+        kinds without the zombie's callbacks still firing on every write."""
+        with self._lock:
+            handlers = self._watchers.get(kind)
+            if handlers is not None:
+                self._watchers[kind] = [h for h in handlers
+                                        if h is not handler]
+
     def _dispatch(self, kind: str, event_type: str, old, new) -> None:
         for handler in self._watchers.get(kind, []):
             if event_type == "ADDED" and handler.added is not None:
@@ -294,7 +305,7 @@ class Store:
         """Bulk Pod-Scheduled events from pre-derived ns/name keys; the
         message is lazy (ScheduledEvent), so the cost per placement is one
         small object, not a string format."""
-        ts = time.time()
+        ts = clock.now()
         with self._lock:
             self.events.extend(map(ScheduledEvent, keys, hosts, repeat(ts)))
 

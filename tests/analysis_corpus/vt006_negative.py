@@ -1,0 +1,36 @@
+"""VT006 negative corpus: the sanctioned carry-threading idiom (rebind the
+donated name from the dispatch result before any further read), plus a
+justified suppression proving the disable comment is load-bearing."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("spec",), donate_argnums=(1,))
+def stage(spec, carry):
+    return carry, carry
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def stage_undonated(spec, carry):
+    return carry
+
+
+def driver(spec, carry):
+    # rebinding from the call's own result clears the donation: every
+    # later read sees the NEW carry, never the invalidated buffer
+    packed, carry = stage(spec, carry)
+    packed2, carry = stage(spec, carry)
+    return packed, packed2, carry["used"]
+
+
+def driver_undonated(spec, carry):
+    out = stage_undonated(spec, carry)
+    return out, carry["used"]  # no donation — reads stay legal
+
+
+def driver_suppressed(spec, carry):
+    packed = stage(spec, carry)
+    shape = carry["used"].shape  # vclint: disable=VT006 - CPU-backend test shim: donation is a no-op there and this reads metadata only
+    return packed, shape

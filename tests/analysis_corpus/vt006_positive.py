@@ -1,0 +1,36 @@
+"""VT006 positive corpus: donated buffers read host-side after dispatch."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("spec",), donate_argnums=(1,))
+def stage(spec, carry):
+    return carry
+
+
+@functools.partial(
+    jax.jit, static_argnames=("layout",),
+    donate_argnums=(1, 2))
+def stage_two(layout, carry, scratch):
+    return carry
+
+
+def driver(spec, carry):
+    packed = stage(spec, carry)
+    total = carry["used"].sum()  # vclint-expect: VT006
+    return packed, total
+
+
+def driver_two(layout, carry, scratch):
+    out = stage_two(layout, carry, scratch)
+    # reading EITHER donated argument after dispatch is a stale deref
+    leak = scratch  # vclint-expect: VT006
+    return out, leak
+
+
+def driver_chain(spec, carry):
+    # donation without rebinding, then a second dispatch reads the corpse
+    stage(spec, carry)
+    return stage(spec, carry)  # vclint-expect: VT006

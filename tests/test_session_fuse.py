@@ -1,0 +1,236 @@
+"""Whole-session fused dispatch (ops/session_fuse.py) vs the per-action path.
+
+The contract: within the fuse envelope the chained device program — allocate
+rounds -> backfill -> preempt -> reclaim with donated carries and device-
+rebuilt heaps — lands EXACTLY the session state the per-action path lands
+(`VOLCANO_TPU_FUSE=0`): same bindings/evictions in the same effector order,
+same events, same SnapshotKeeper dirty-set consequences (consecutive-session
+parity), same drf/proportion shares and preemption metrics. Out-of-envelope
+sessions must fall back per-action with a recorded `fuse_fallback` reason
+and identical results. Warm fused sessions must reuse every compiled stage
+program."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from tests.helpers import close_session, make_tiers, open_session
+from tests.test_evict_kernel import (
+    ACTIONS,
+    TIER_SETS,
+    _overcommit_cluster,
+    _session_signature,
+)
+from volcano_tpu.scheduler.framework import run_actions
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_resource_list_with_pods,
+)
+
+# force rounds mode: the fuzz clusters sit far below the auto threshold,
+# and the fused chain only engages when allocate runs the packed rounds
+# solve (exactly the headline regime)
+ARGS = {"tpuscore": {"tpuscore.mode": "rounds"}}
+
+
+def _run(cache, tiers_spec, fuse_on, monkeypatch, sessions: int = 1,
+         actions=ACTIONS):
+    import volcano_tpu.ops.victimview as vv
+
+    from volcano_tpu.scheduler import metrics
+
+    monkeypatch.setenv("VOLCANO_TPU_EVICT", "1")
+    monkeypatch.setenv("VOLCANO_TPU_FUSE", "1" if fuse_on else "0")
+    monkeypatch.setattr(vv.VictimSelector, "MIN_BATCH", 1)
+    reg = metrics.registry()
+    m0 = (reg.preemption_victims.get(), reg.preemption_attempts.get())
+    sig = None
+    profs = []
+    for _ in range(sessions):
+        ssn = open_session(
+            cache, make_tiers(["tpuscore"], *tiers_spec, arguments=ARGS))
+        try:
+            run_actions(ssn, actions)
+            sig = _session_signature(ssn)
+            profs.append(dict(ssn.plugins["tpuscore"].profile))
+        finally:
+            close_session(ssn)
+    sig["metrics"] = (reg.preemption_victims.get() - m0[0],
+                      reg.preemption_attempts.get() - m0[1])
+    return sig, dict(cache.binder.binds), list(cache.evictor.evicts), profs
+
+
+@pytest.mark.parametrize("tiers_spec,seed", [
+    (TIER_SETS[0], 11), (TIER_SETS[0], 42), (TIER_SETS[2], 7)])
+def test_fused_chain_parity(tiers_spec, seed, monkeypatch):
+    """Fused-vs-per-action over randomized overcommitted clusters: task
+    statuses/placements, node accounting, job readiness, plugin shares,
+    fit errors, preemption metrics, binds and evictions in effector order
+    — all equal, and the fused path must actually have run."""
+    got = _run(_overcommit_cluster(seed), tiers_spec, True, monkeypatch)
+    want = _run(_overcommit_cluster(seed), tiers_spec, False, monkeypatch)
+    assert got[0] == want[0], (tiers_spec, seed)
+    assert got[1] == want[1]          # binds
+    assert got[2] == want[2]          # evictions, in effector order
+    prof = got[3][0]
+    assert prof.get("fuse") == 1, prof.get("fuse_fallback", prof)
+    assert "fuse_fallback" not in prof, prof["fuse_fallback"]
+    # the per-action arm must NOT have fused
+    assert "fuse" not in want[3][0]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", list(range(300, 308)))
+def test_fused_chain_parity_wide(seed, monkeypatch):
+    """Wider fuzz band: fresh cluster shapes (fresh buckets, fresh
+    compiles) across all tier sets."""
+    import random
+
+    rng = random.Random(seed * 13)
+    kw = dict(nodes=rng.choice([4, 7, 9]),
+              running_jobs=rng.choice([8, 14, 18]),
+              tasks_per_job=rng.choice([3, 4, 5]),
+              queues=rng.choice([2, 3]),
+              hi_jobs=rng.choice([3, 5]))
+    tiers_spec = TIER_SETS[seed % len(TIER_SETS)]
+    got = _run(_overcommit_cluster(seed, **kw), tiers_spec, True,
+               monkeypatch)
+    want = _run(_overcommit_cluster(seed, **kw), tiers_spec, False,
+                monkeypatch)
+    assert got[0] == want[0], (kw, tiers_spec)
+    assert got[1] == want[1]
+    assert got[2] == want[2]
+
+
+def test_consecutive_sessions_parity_with_honest_fallback(monkeypatch):
+    """Two back-to-back sessions on one cache: the first session's
+    evictions leave releasing capacity, which is OUTSIDE the fuse envelope
+    (the allocate serial pipeline pass would run between stages) — the
+    second session must fall back per-action with a recorded reason, and
+    end-state parity must hold through the SnapshotKeeper dirty-sets."""
+    tiers = TIER_SETS[0]
+    got = _run(_overcommit_cluster(21), tiers, True, monkeypatch,
+               sessions=2)
+    want = _run(_overcommit_cluster(21), tiers, False, monkeypatch,
+                sessions=2)
+    assert got[0] == want[0]
+    assert got[1] == want[1]
+    assert got[2] == want[2]
+    assert got[3][0].get("fuse") == 1
+    assert "releasing" in got[3][1].get("fuse_fallback", ""), got[3][1]
+
+
+def test_warm_fused_session_pins_no_compiles(monkeypatch):
+    """A second identically-shaped fused session must reuse every compiled
+    stage program (bucketed shapes + static specs/layouts/sizes)."""
+    from volcano_tpu.utils.jaxcompile import CompileWatcher
+
+    tiers = TIER_SETS[0]
+    _run(_overcommit_cluster(11), tiers, True, monkeypatch)
+    watcher = CompileWatcher.install()
+    with watcher.assert_no_compiles("warm fused session"):
+        got = _run(_overcommit_cluster(11), tiers, True, monkeypatch)
+    assert got[3][0].get("fuse") == 1
+
+
+def test_env_flag_restores_per_action_path(monkeypatch):
+    """VOLCANO_TPU_FUSE=0 must route through the untouched per-action
+    loop: no fuse profile keys at all, batched evict still engaged."""
+    got = _run(_overcommit_cluster(11), TIER_SETS[0], False, monkeypatch)
+    prof = got[3][0]
+    assert "fuse" not in prof and "fuse_fallback" not in prof
+    assert "evict_preempt" in prof  # per-action batched evict still ran
+
+
+def test_scalar_resources_fall_back_per_action(monkeypatch):
+    """Scalar dims leave the evict envelope: the chain must record a
+    fuse_fallback and produce results identical to the per-action path
+    (which itself falls back to the dense/serial ladder)."""
+    def cluster():
+        cache = _overcommit_cluster(11)
+        rl = build_resource_list_with_pods("8", "16Gi", pods=64)
+        rl["nvidia.com/gpu"] = "4"
+        cache.add_node(build_node("node-gpu", rl))
+        return cache
+
+    got = _run(cluster(), TIER_SETS[0], True, monkeypatch)
+    want = _run(cluster(), TIER_SETS[0], False, monkeypatch)
+    assert got[0] == want[0]
+    assert got[1] == want[1]
+    assert got[2] == want[2]
+    prof = got[3][0]
+    assert "fuse" not in prof
+    assert "fuse_fallback" in prof, prof
+
+
+def test_chain_grammar():
+    """Only order-respecting chains containing allocate+preempt fuse."""
+    from volcano_tpu.ops.session_fuse import _split_chain
+
+    assert _split_chain(("allocate", "backfill", "preempt", "reclaim")) \
+        == ([], ["allocate", "backfill", "preempt", "reclaim"])
+    assert _split_chain(("enqueue", "allocate", "preempt")) \
+        == (["enqueue"], ["allocate", "preempt"])
+    assert _split_chain(("allocate",)) is None            # no evict stage
+    assert _split_chain(("allocate", "backfill")) is None  # no preempt
+    assert _split_chain(("allocate", "preempt", "backfill")) is None
+    assert _split_chain(("preempt", "reclaim")) is None   # no allocate
+    assert _split_chain(("allocate", "reclaim", "preempt")) is None
+
+
+def test_fallback_applies_nothing_twice(monkeypatch):
+    """When the fused chain falls back mid-way, the per-action rerun must
+    not double-apply: total binds/evictions equal the oracle's. Forced by
+    an out-of-envelope plugin set (custom preemptable fn -> evict encode
+    _Unsupported at build time)."""
+    monkeypatch.setenv("VOLCANO_TPU_EVICT", "1")
+    monkeypatch.setenv("VOLCANO_TPU_FUSE", "1")
+
+    cache = _overcommit_cluster(11)
+    ssn = open_session(
+        cache, make_tiers(["tpuscore"], *TIER_SETS[0], arguments=ARGS))
+    try:
+        ssn.add_preemptable_fn("priority", lambda c, cs: cs)
+        run_actions(ssn, ACTIONS)
+        prof = ssn.plugins["tpuscore"].profile
+        assert "fuse_fallback" in prof, prof
+        sig = _session_signature(ssn)
+    finally:
+        close_session(ssn)
+
+    monkeypatch.setenv("VOLCANO_TPU_FUSE", "0")
+    cache2 = _overcommit_cluster(11)
+    ssn = open_session(
+        cache2, make_tiers(["tpuscore"], *TIER_SETS[0], arguments=ARGS))
+    try:
+        ssn.add_preemptable_fn("priority", lambda c, cs: cs)
+        run_actions(ssn, ACTIONS)
+        sig2 = _session_signature(ssn)
+    finally:
+        close_session(ssn)
+    assert sig == sig2
+    assert dict(cache.binder.binds) == dict(cache2.binder.binds)
+    assert list(cache.evictor.evicts) == list(cache2.evictor.evicts)
+
+
+def test_devprof_counters_land_in_profile(monkeypatch):
+    """The session device-interaction counters (sync points, D2H fetches,
+    overlap window) must be collectable around a fused session."""
+    from volcano_tpu.utils import devprof
+
+    monkeypatch.setenv("VOLCANO_TPU_EVICT", "1")
+    monkeypatch.setenv("VOLCANO_TPU_FUSE", "1")
+    cache = _overcommit_cluster(11)
+    ssn = open_session(
+        cache, make_tiers(["tpuscore"], *TIER_SETS[0], arguments=ARGS))
+    prof = {}
+    try:
+        with devprof.session(prof):
+            run_actions(ssn, ACTIONS)
+    finally:
+        close_session(ssn)
+    assert prof["tpu_d2h_fetches"] >= 4   # one per fused stage
+    assert prof["tpu_sync_points"] >= prof["tpu_d2h_fetches"]
+    assert prof["tpu_overlap_ms"] >= 0.0

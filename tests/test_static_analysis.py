@@ -34,7 +34,7 @@ pytestmark = pytest.mark.lint
 
 REPO = Path(__file__).resolve().parent.parent
 CORPUS = Path(__file__).resolve().parent / "analysis_corpus"
-RULE_IDS = ("VT001", "VT002", "VT003", "VT004", "VT005")
+RULE_IDS = ("VT001", "VT002", "VT003", "VT004", "VT005", "VT006")
 
 _EXPECT_RE = re.compile(r"#\s*vclint-expect:\s*(VT\d{3})")
 

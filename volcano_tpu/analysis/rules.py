@@ -170,7 +170,8 @@ class BucketShape(Rule):
 
     id = "VT002"
     title = "unbucketed dynamic shape reaches a jit-static sink"
-    patterns = ("*/ops/solver.py", "*/ops/rounds.py", "*/ops/evict.py")
+    patterns = ("*/ops/solver.py", "*/ops/rounds.py", "*/ops/evict.py",
+                "*/ops/session_fuse.py")
 
     SANITIZERS = {"_bucket"}
     BLESSED_CALLS = {"pad_encoded"}
@@ -178,7 +179,11 @@ class BucketShape(Rule):
     SPEC_CTORS = {"SolveSpec", "EvictSpec"}
     KERNEL_ENTRIES = {"solve_allocate", "solve_rounds", "solve_rounds_packed",
                       "solve_preempt", "solve_reclaim", "solve_backfill",
-                      "_solve_packed"}
+                      "_solve_packed",
+                      # fused session stages: their `sizes` tuples are
+                      # jit-static exactly like spec fields
+                      "_fuse_alloc", "_fuse_backfill", "_fuse_preempt",
+                      "_fuse_reclaim"}
     ALLOC_FUNCS = {"zeros", "ones", "empty", "full"}
     # window-size sinks: arg 1 (or k=) is a static shape in the compiled
     # program — an unbucketed k is a per-churn retrace
@@ -620,6 +625,7 @@ class HotPathDeterminism(Rule):
     id = "VT005"
     title = "unsorted set iteration on a hot path"
     patterns = ("*/ops/encoder.py", "*/ops/solver.py", "*/ops/evict.py",
+                "*/ops/session_fuse.py",
                 "*/scheduler/cache/*.py", "*/controllers/*.py",
                 # the sim's replay determinism contract (same seed =>
                 # identical event-log hash) dies the moment any component
@@ -809,3 +815,138 @@ class HotPathDeterminism(Rule):
                     self._flag(node, "set.pop()", path, findings)
             elif isinstance(node, ast.Starred) and sv(node.value):
                 self._flag(node, "* unpacking", path, findings)
+
+
+# ---------------------------------------------------------------------------
+# VT006 — donated-buffer hygiene
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class DonatedBufferReuse(Rule):
+    """Host-side reuse of an argument donated to a device dispatch.
+
+    The fused session chain (ops/session_fuse.py) passes its carry pytree
+    with ``donate_argnums`` so XLA reuses the buffer memory across stages.
+    Donation INVALIDATES the caller's arrays: a later host-side read of the
+    same variable dereferences a deleted buffer and raises (or, worse,
+    silently reads repurposed memory on backends that alias instead of
+    poisoning). The rule learns which local functions donate which
+    positional arguments from their ``jax.jit(..., donate_argnums=...)`` /
+    ``functools.partial(jax.jit, ..., donate_argnums=...)`` decorators,
+    then flags any read of a donated name after the dispatch and before a
+    rebind. Rebinding from the call's own result (the carry-threading
+    idiom ``out, carry = stage(..., carry)``) is the sanctioned pattern and
+    stays clean."""
+
+    id = "VT006"
+    title = "donated buffer reused host-side after dispatch"
+    patterns = ("*/ops/session_fuse.py", "*/ops/solver.py",
+                "*/ops/rounds.py", "*/ops/evict.py")
+
+    @staticmethod
+    def _donated_positions(tree: ast.AST) -> Dict[str, tuple]:
+        """fn name -> donated positional-arg indices, from decorators."""
+        out: Dict[str, tuple] = {}
+        for fn in _func_defs(tree):
+            for dec in fn.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                callee = dotted(dec.func) or ""
+                head = callee.split(".")[-1]
+                if head == "partial":
+                    if not (dec.args and
+                            (dotted(dec.args[0]) or "").endswith("jit")):
+                        continue
+                elif not callee.endswith("jit"):
+                    continue
+                for kw in dec.keywords:
+                    if kw.arg != "donate_argnums":
+                        continue
+                    vals: List[int] = []
+                    nodes = kw.value.elts \
+                        if isinstance(kw.value, (ast.Tuple, ast.List)) \
+                        else [kw.value]
+                    for n in nodes:
+                        if isinstance(n, ast.Constant) \
+                                and isinstance(n.value, int):
+                            vals.append(n.value)
+                    if vals:
+                        out[fn.name] = tuple(vals)
+        return out
+
+    def check(self, tree, src, path):
+        findings: List[Finding] = []
+        donating = self._donated_positions(tree)
+        if not donating:
+            return findings
+        for fn in _func_defs(tree):
+            self._scan_stmts(fn.body, donating, {}, path, findings)
+        return findings
+
+    # -- statement-ordered walk: loads fire before the enclosing call's
+    # donation takes effect, assignment targets rebind AFTER the value ----
+
+    def _scan_stmts(self, stmts, donating, donated, path, findings):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope
+            for expr in self._value_exprs(stmt):
+                self._scan_expr(expr, donating, donated, path, findings)
+            for tgt in self._store_targets(stmt):
+                donated.pop(tgt, None)
+            for body in (getattr(stmt, "body", None),
+                         getattr(stmt, "orelse", None),
+                         getattr(stmt, "finalbody", None)):
+                if isinstance(body, list):
+                    self._scan_stmts(body, donating, donated, path,
+                                     findings)
+            for handler in getattr(stmt, "handlers", ()) or ():
+                self._scan_stmts(handler.body, donating, donated, path,
+                                 findings)
+
+    @staticmethod
+    def _value_exprs(stmt):
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                             ast.Return, ast.Expr)):
+            return [stmt.value] if stmt.value is not None else []
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, ast.For):
+            return [stmt.iter]
+        if isinstance(stmt, (ast.With,)):
+            return [item.context_expr for item in stmt.items]
+        return []
+
+    @staticmethod
+    def _store_targets(stmt):
+        out: List[str] = []
+        tgts = []
+        if isinstance(stmt, ast.Assign):
+            tgts = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            tgts = [stmt.target]
+        for t in tgts:
+            for node in ast.walk(t):
+                if isinstance(node, ast.Name):
+                    out.append(node.id)
+        return out
+
+    def _scan_expr(self, node, donating, donated, path, findings):
+        for child in ast.iter_child_nodes(node):
+            self._scan_expr(child, donating, donated, path, findings)
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in donated:
+                findings.append(Finding(
+                    self.id, path, node.lineno, node.col_offset,
+                    f"'{node.id}' was donated to device dispatch "
+                    f"'{donated[node.id]}' and read again host-side; "
+                    f"donation invalidates the buffer — rebind from the "
+                    f"dispatch result instead"))
+                donated.pop(node.id)
+        elif isinstance(node, ast.Call):
+            callee = (dotted(node.func) or "").split(".")[-1]
+            for p in donating.get(callee, ()):
+                if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                    donated[node.args[p].id] = callee

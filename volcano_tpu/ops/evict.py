@@ -214,6 +214,28 @@ def _heap_push(row, size, item, less):
     return row.at[pos].set(item), size + 1
 
 
+def _live_next(live):
+    """[T] bool -> [T] int32: for each flat index i, the smallest j >= i
+    with live[j] (T when none). Candidate tasks are contiguous per job, so
+    next_live < job_task_end decides "this job still has an unconsumed live
+    task" and p_next[ptr] IS the next task the serial walk would pop —
+    the device twin of the host rebuilding its pending task queues after an
+    earlier stage consumed some candidates (session_fuse skip masks)."""
+    t_total = live.shape[0]
+    idx = jnp.arange(t_total, dtype=jnp.int32)
+    cand = jnp.where(live, idx, jnp.int32(t_total))
+    return jnp.flip(lax.cummin(jnp.flip(cand)))
+
+
+def _has_live(enc, ptr_val, end_val):
+    """ptr < end AND a live candidate remains at-or-after ptr (p_next is
+    the identity permutation on the per-action path, where consumed
+    candidates are exactly [start, ptr))."""
+    t_total = enc["p_next"].shape[0]
+    nxt = enc["p_next"][jnp.clip(ptr_val, 0, t_total - 1)]
+    return (ptr_val < end_val) & (nxt < end_val)
+
+
 def _job_less(spec: EvictSpec, enc, st):
     """3-way job_order_cmp as a traced less(a, b): enabled plugin keys in
     tier order (priority desc, gang non-ready-first, drf share asc), then
@@ -430,6 +452,9 @@ def _apply_pipeline(enc, st, t, node):
     st["wait"] = st["wait"].at[j].add(1)
     st["job_alloc"] = st["job_alloc"].at[j].add(req)
     st["queue_alloc"] = st["queue_alloc"].at[q].add(req)
+    # consumed-candidate mark: the fused chain hands this to the next
+    # stage as its skip mask (a pipelined task is no longer PENDING)
+    st["p_done"] = st["p_done"].at[t].set(True)
     return _log_append(st, OP_PIPELINE, t, node, jnp.bool_(True))
 
 
@@ -473,6 +498,8 @@ def _discard(enc, st, stmt_start):
         st["wait"] = st["wait"].at[pj].add(-is_p.astype(jnp.int32))
         st["job_alloc"] = st["job_alloc"].at[pj].add(-preq)
         st["queue_alloc"] = st["queue_alloc"].at[pq].add(-preq)
+        st["p_done"] = st["p_done"].at[t].set(
+            jnp.where(is_p, False, st["p_done"][t]))
         st["log_len"] = i
         return st
 
@@ -601,23 +628,12 @@ def _preempt_walk(spec: EvictSpec, enc, st, t, j, intra):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("spec",))
-def solve_preempt(spec: EvictSpec, enc: dict):
-    """The whole preempt action (preempt.py execute) as one fused program:
-    per-queue phase 1 (job heap pops, per-job statements, gang-pipelined
-    commit/discard) then phase 2 (intra-job task-vs-task, per-task commit),
-    interleaved per queue exactly as the host loop runs them. Returns one
-    packed int32 array: flattened op log + [log_len, rr, victims, attempts,
-    fail, underflow]."""
-    n = enc["node_used"].shape[0]
-    qp = enc["queue_real"].shape[0]
-    ju = enc["under_jobs"].shape[0]
-    t_total = enc["p_req"].shape[0]
-    j_total = enc["job_prio"].shape[0]
-    l_total = enc["log0"].shape[0]
-    step_budget = jnp.int32(8 * (t_total + j_total + qp + ju) + 64)
-
-    st = dict(
+def preempt_state0(enc: dict) -> dict:
+    """Initial preempt machine state from the encoded action arrays. The
+    session-fused driver overrides the dynamic slices (used/cnt/ready/
+    alloc/heaps/p_done) with carry-bridged values; the per-action entry
+    uses the host-encoded initials as-is."""
+    return dict(
         used=enc["node_used"], cnt=enc["node_cnt"],
         alive=enc["vic_alive0"],
         ready=enc["job_ready0"], wait=enc["job_wait0"],
@@ -626,6 +642,7 @@ def solve_preempt(spec: EvictSpec, enc: dict):
         heap=enc["heap0"], hsize=enc["hsize0"],
         log=enc["log0"], log_len=jnp.int32(0),
         rr=enc["rr0"].astype(jnp.int32),
+        p_done=jnp.zeros(enc["p_req"].shape[0], bool),
         mode=jnp.int32(M_QUEUE), qi=jnp.int32(0), cur_job=jnp.int32(0),
         phase2=jnp.bool_(False), assigned=jnp.bool_(False),
         stmt_start=jnp.int32(0), u2=jnp.int32(0),
@@ -633,6 +650,27 @@ def solve_preempt(spec: EvictSpec, enc: dict):
         fail=jnp.bool_(False), underflow=jnp.bool_(False),
         steps=jnp.int32(0),
     )
+
+
+def evict_tail(st: dict):
+    """Pack the machine's final state into the single-fetch int32 result:
+    flattened op log + [log_len, rr, victims, attempts, fail, underflow]."""
+    tail = jnp.stack([
+        st["log_len"], st["rr"], st["victims"], st["attempts"],
+        st["fail"].astype(jnp.int32), st["underflow"].astype(jnp.int32)])
+    return jnp.concatenate([st["log"].reshape(-1), tail])
+
+
+def preempt_machine(spec: EvictSpec, enc: dict, st: dict) -> dict:
+    """The whole preempt action (preempt.py execute) as one fused program:
+    per-queue phase 1 (job heap pops, per-job statements, gang-pipelined
+    commit/discard) then phase 2 (intra-job task-vs-task, per-task commit),
+    interleaved per queue exactly as the host loop runs them."""
+    qp = enc["queue_real"].shape[0]
+    ju = enc["under_jobs"].shape[0]
+    t_total = enc["p_req"].shape[0]
+    j_total = enc["job_prio"].shape[0]
+    step_budget = jnp.int32(8 * (t_total + j_total + qp + ju) + 64)
 
     def pipelined(st, j):
         if not spec.use_gang_pipelined:
@@ -708,8 +746,8 @@ def solve_preempt(spec: EvictSpec, enc: dict):
             past = st["u2"] >= ju
             j = enc["under_jobs"][jnp.minimum(st["u2"], ju - 1)]
             has = ~past & (j >= 0) \
-                & (st["ptr"][jnp.maximum(j, 0)]
-                   < enc["job_task_end"][jnp.maximum(j, 0)])
+                & _has_live(enc, st["ptr"][jnp.maximum(j, 0)],
+                            enc["job_task_end"][jnp.maximum(j, 0)])
             st["cur_job"] = jnp.where(has, j, st["cur_job"])
             st["phase2"] = jnp.bool_(True)
             st["mode"] = jnp.where(
@@ -726,7 +764,7 @@ def solve_preempt(spec: EvictSpec, enc: dict):
     def task_step(st):
         st = dict(st)
         j = st["cur_job"]
-        have = st["ptr"][j] < enc["job_task_end"][j]
+        have = _has_live(enc, st["ptr"][j], enc["job_task_end"][j])
         phase2 = st["phase2"]
 
         def no_task(st):
@@ -738,8 +776,8 @@ def solve_preempt(spec: EvictSpec, enc: dict):
 
         def do_task(st):
             st = dict(st)
-            t = st["ptr"][j]
-            st["ptr"] = st["ptr"].at[j].add(1)
+            t = enc["p_next"][jnp.clip(st["ptr"][j], 0, t_total - 1)]
+            st["ptr"] = st["ptr"].at[j].set(t + 1)
             st["stmt_start"] = jnp.where(phase2, st["log_len"],
                                          st["stmt_start"])
             host, st = _preempt_walk(spec, enc, st, t, j, phase2)
@@ -769,12 +807,14 @@ def solve_preempt(spec: EvictSpec, enc: dict):
     def cond(st):
         return (st["mode"] != M_DONE) & ~st["fail"]
 
-    st = lax.while_loop(cond, body, st)
-    tail = jnp.stack([
-        st["log_len"], st["rr"], st["victims"], st["attempts"],
-        st["fail"].astype(jnp.int32), st["underflow"].astype(jnp.int32)])
-    del l_total
-    return jnp.concatenate([st["log"].reshape(-1), tail])
+    return lax.while_loop(cond, body, st)
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def solve_preempt(spec: EvictSpec, enc: dict):
+    """Per-action packed preempt entry: host-encoded initial state, packed
+    single-fetch result (evict_tail)."""
+    return evict_tail(preempt_machine(spec, enc, preempt_state0(enc)))
 
 
 # ---------------------------------------------------------------------------
@@ -865,17 +905,10 @@ def _reclaim_walk(spec: EvictSpec, enc, st, t, j):
     return out["assigned"], st
 
 
-@functools.partial(jax.jit, static_argnames=("spec",))
-def solve_reclaim(spec: EvictSpec, enc: dict):
-    """The whole reclaim action (reclaim.py execute) as one fused program:
-    queue heap rotation (overused queues drop out un-re-pushed), one job
-    pop and one task per queue visit, direct evict/pipeline ops. Packed
-    int32 result like solve_preempt."""
-    j_total = enc["job_prio"].shape[0]
-    q_total = enc["queue_alloc0"].shape[0]
-    t_total = enc["p_req"].shape[0]
-
-    st = dict(
+def reclaim_state0(enc: dict) -> dict:
+    """Initial reclaim machine state (fused driver overrides the dynamic
+    slices, exactly like preempt_state0)."""
+    return dict(
         used=enc["node_used"], cnt=enc["node_cnt"],
         alive=enc["vic_alive0"],
         ready=enc["job_ready0"], wait=enc["job_wait0"],
@@ -885,10 +918,20 @@ def solve_reclaim(spec: EvictSpec, enc: dict):
         qheap=enc["qheap0"], qhsize=enc["qhsize0"],
         log=enc["log0"], log_len=jnp.int32(0),
         rr=enc["rr0"].astype(jnp.int32),
+        p_done=jnp.zeros(enc["p_req"].shape[0], bool),
         victims=jnp.int32(0), attempts=jnp.int32(0),
         fail=jnp.bool_(False), underflow=jnp.bool_(False),
         steps=jnp.int32(0),
     )
+
+
+def reclaim_machine(spec: EvictSpec, enc: dict, st: dict) -> dict:
+    """The whole reclaim action (reclaim.py execute) as one fused program:
+    queue heap rotation (overused queues drop out un-re-pushed), one job
+    pop and one task per queue visit, direct evict/pipeline ops."""
+    j_total = enc["job_prio"].shape[0]
+    q_total = enc["queue_alloc0"].shape[0]
+    t_total = enc["p_req"].shape[0]
     step_budget = jnp.int32(4 * (t_total + j_total + q_total) + 64)
     eps = enc["eps"]
 
@@ -918,12 +961,14 @@ def solve_reclaim(spec: EvictSpec, enc: dict):
                 j, row, nsz = _heap_pop(st["heap"][q], st["hsize"][q], less)
                 st["heap"] = st["heap"].at[q].set(row)
                 st["hsize"] = st["hsize"].at[q].set(nsz)
-                has_task = st["ptr"][j] < enc["job_task_end"][j]
+                has_task = _has_live(enc, st["ptr"][j],
+                                     enc["job_task_end"][j])
 
                 def with_task(st):
                     st = dict(st)
-                    t = st["ptr"][j]
-                    st["ptr"] = st["ptr"].at[j].add(1)
+                    t = enc["p_next"][jnp.clip(st["ptr"][j], 0,
+                                               t_total - 1)]
+                    st["ptr"] = st["ptr"].at[j].set(t + 1)
                     assigned, st = _reclaim_walk(spec, enc, st, t, j)
 
                     def repush(st):
@@ -943,11 +988,13 @@ def solve_reclaim(spec: EvictSpec, enc: dict):
 
         return lax.cond(over, lambda s: s, visit, st)
 
-    st = lax.while_loop(cond, body, st)
-    tail = jnp.stack([
-        st["log_len"], st["rr"], st["victims"], st["attempts"],
-        st["fail"].astype(jnp.int32), st["underflow"].astype(jnp.int32)])
-    return jnp.concatenate([st["log"].reshape(-1), tail])
+    return lax.while_loop(cond, body, st)
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def solve_reclaim(spec: EvictSpec, enc: dict):
+    """Per-action packed reclaim entry (evict_tail result)."""
+    return evict_tail(reclaim_machine(spec, enc, reclaim_state0(enc)))
 
 
 # ---------------------------------------------------------------------------
@@ -1062,14 +1109,15 @@ def _profile(ssn) -> dict:
     return p.profile if p is not None else {}
 
 
-def _common_view(ssn):
+def _common_view(ssn, view=None):
     if os.environ.get("VOLCANO_TPU_EVICT", "1") == "0":
         raise _Unsupported("VOLCANO_TPU_EVICT=0")
     if getattr(ssn, "batch_allocator", None) is None:
         raise _Unsupported("tpuscore off")
-    from volcano_tpu.ops import preemptview
+    if view is None:
+        from volcano_tpu.ops import preemptview
 
-    view = preemptview.build(ssn)
+        view = preemptview.build(ssn)
     if view is None:
         raise _Unsupported("dense view unsupported for this session")
     if len(view.rnames) != 2:
@@ -1102,6 +1150,25 @@ def _eligible_jobs(ssn):
     return out
 
 
+def _check_victim_tier(ssn, kind: str, drf) -> List[str]:
+    """The deciding victim tier for ``kind``, gate-checked (raises
+    _Unsupported outside the vectorized envelope)."""
+    decide = _deciding_victim_tier(ssn, kind)
+    if any(n not in VECTORIZED_VICTIM_FNS for n in decide):
+        raise _Unsupported(f"unsupported victim plugins: {decide}")
+    if "drf" in decide:
+        if drf is None:
+            raise _Unsupported("drf victims without the drf plugin")
+        if drf.namespace_opts and len(
+                {j.namespace for j in ssn.jobs.values()}) > 1:
+            # the weighted-namespace branch only acts on CROSS-namespace
+            # claimee pairs; with one namespace it is provably a no-op
+            raise _Unsupported(
+                "weighted-namespace drf victims over multiple "
+                "namespaces not modeled")
+    return decide
+
+
 def _deciding_victim_tier(ssn, kind: str) -> List[str]:
     flag = "enabled_preemptable" if kind == "preempt" \
         else "enabled_reclaimable"
@@ -1130,15 +1197,26 @@ def build(ssn, kind: str):
 
 class _EvictPlan:
     """One encoded preempt/reclaim action: device arrays + the decode maps
-    the host replay needs. Pure until run() applies a successful solve."""
+    the host replay needs. Pure until run() applies a successful solve.
 
-    def __init__(self, ssn, kind: str):
+    With ``fused=True`` (session_fuse driver) the encode runs BEFORE the
+    allocate action instead of after it: the candidate/victim/job/queue
+    axes are identical either way (allocate only flips PENDING->BINDING,
+    which no axis layout depends on), but everything state-DEPENDENT —
+    the initial job heaps, the under-request list, which jobs still have
+    pending tasks — is left to the device stage, which rebuilds it from
+    the carry under post-allocate keys. The fused extras (push orders,
+    eligibility/validity vectors) encode the serial loop's STATIC
+    iteration order so the device can replay its dynamic decisions."""
+
+    def __init__(self, ssn, kind: str, fused: bool = False, view=None):
         from volcano_tpu.ops import encoder as enc_mod
 
         t0 = time.perf_counter()
         self.ssn = ssn
         self.kind = kind
-        view = _common_view(ssn)
+        self.fused = fused
+        view = _common_view(ssn, view)
         self.view = view
 
         job_order = enc_mod._enabled_plugins(
@@ -1160,20 +1238,15 @@ class _EvictPlan:
         task_key = ssn.stock_task_order_key()
         if task_key is None:
             raise _Unsupported("custom task-order comparator")
-        decide = _deciding_victim_tier(ssn, kind)
-        if any(n not in VECTORIZED_VICTIM_FNS for n in decide):
-            raise _Unsupported(f"unsupported victim plugins: {decide}")
         drf = ssn.plugins.get("drf")
-        if "drf" in decide:
-            if drf is None:
-                raise _Unsupported("drf victims without the drf plugin")
-            if drf.namespace_opts and len(
-                    {j.namespace for j in ssn.jobs.values()}) > 1:
-                # the weighted-namespace branch only acts on CROSS-namespace
-                # claimee pairs; with one namespace it is provably a no-op
-                raise _Unsupported(
-                    "weighted-namespace drf victims over multiple "
-                    "namespaces not modeled")
+        decide = _check_victim_tier(ssn, kind, drf)
+        if fused and kind == "preempt":
+            # one fused encode serves both evict stages; the reclaim tier
+            # must clear the same gates, and the same-job/same-queue
+            # adjacency matrices below must cover the union of both tiers
+            self.reclaim_decide = _check_victim_tier(ssn, "reclaim", drf)
+        else:
+            self.reclaim_decide = ()
 
         fdt = _f_dtype()
         node_names = view.node_names
@@ -1372,12 +1445,17 @@ class _EvictPlan:
             arrays["rr0"] = np.int32(helper._last_processed_node_index)
             arrays["num_to_find"] = np.int32(
                 helper.calculate_num_of_feasible_nodes_to_find(n))
-        if "drf" in decide or "gang" in decide:
+        tiers_union = set(decide) | set(self.reclaim_decide)
+        if "drf" in tiers_union or "gang" in tiers_union:
             vj = np.where(vic_valid, vic_job, -1 - np.arange(v)[None, :])
             arrays["vic_samejob"] = vj[:, :, None] == vj[:, None, :]
-        if "proportion" in decide:
+        if "proportion" in tiers_union:
             vq = np.where(vic_valid, vic_queue, -1 - np.arange(v)[None, :])
             arrays["vic_samequeue"] = vq[:, :, None] == vq[:, None, :]
+        # live-pointer permutation: identity on the per-action path (the
+        # candidate axis holds exactly the still-pending tasks); the fused
+        # stages overlay a device-computed next-live map instead
+        arrays["p_next"] = np.arange(tb, dtype=np.int32)
 
         # ---- heaps (initial arrays built by the REAL PriorityQueue at
         # encode-time keys — every initial push happens before any state
@@ -1387,7 +1465,66 @@ class _EvictPlan:
         jcap = _bucket(max(1, max(
             (sum(1 for j in pre_jobs if j.queue == qn) for qn in qnames),
             default=1)))
-        if kind == "preempt":
+        if fused:
+            # the initial heaps depend on post-allocate state (which jobs
+            # still have pending tasks, and their drf/gang keys), so the
+            # fused chain builds them ON DEVICE from these static push
+            # orders — the serial loops' iteration order, with the dynamic
+            # conditions (pending-task liveness, job validity) left to the
+            # stage wrappers (session_fuse)
+            proc_rows: Dict[str, int] = {}
+            proc_queues: List[int] = []
+            push_jobs: List[int] = []
+            push_rows: List[int] = []
+            ev_jobs: List[int] = []
+            ev_qrow: List[int] = []
+            for job in eligible:
+                row = proc_rows.get(job.queue)
+                if row is None:
+                    row = proc_rows[job.queue] = len(proc_queues)
+                    proc_queues.append(qnames[job.queue])
+                ev_jobs.append(jidx[job.uid])
+                ev_qrow.append(qnames[job.queue])
+                if job.task_status_index.get(TaskStatus.PENDING):
+                    push_jobs.append(jidx[job.uid])
+                    push_rows.append(row)
+            qp = _bucket(max(len(proc_queues), 1))
+            queue_real = np.zeros(qp, bool)
+            queue_real[:len(proc_queues)] = True
+            pb = _bucket(max(len(push_jobs), 1))
+            f_push_jobs = np.full(pb, -1, np.int32)
+            f_push_jobs[:len(push_jobs)] = push_jobs
+            f_push_row = np.zeros(pb, np.int32)
+            f_push_row[:len(push_rows)] = push_rows
+            eb = _bucket(max(len(ev_jobs), 1))
+            f_ev_jobs = np.full(eb, -1, np.int32)
+            f_ev_jobs[:len(ev_jobs)] = ev_jobs
+            f_ev_qrow = np.zeros(eb, np.int32)
+            f_ev_qrow[:len(ev_qrow)] = ev_qrow
+            f_elig0 = np.zeros(jb, bool)
+            for job in eligible:
+                f_elig0[jidx[job.uid]] = True
+            # valid_task_num changes ONLY via evictions within the chain
+            # (RELEASING is neither allocated nor pending); the reclaim
+            # stage re-derives post-preempt validity as vtn0 - evicted
+            f_vtn0 = np.zeros(jb, np.int32)
+            f_job_attr = np.zeros(jb, bool)
+            for i, job in enumerate(jobs):
+                f_vtn0[i] = job.valid_task_num()
+                if drf is not None:
+                    f_job_attr[i] = drf.job_attrs.get(job.uid) is not None
+            arrays.update(
+                queue_real=queue_real,
+                f_push_jobs=f_push_jobs, f_push_row=f_push_row,
+                f_ev_jobs=f_ev_jobs, f_ev_qrow=f_ev_qrow,
+                f_elig0=f_elig0, f_vtn0=f_vtn0, f_job_attr=f_job_attr)
+            # every fused-stage jit-static size, derived HERE from the
+            # bucket ladder (n is deliberately unbucketed, like the node
+            # axis itself — deployment-stable, not churny)
+            self.fuse_sizes = dict(
+                qp=qp, jcap=jcap, ju=pb, qb=qb, jb=jb, tb=tb, n=n,
+                qh=_bucket(max(len(proc_queues), 1)))
+        elif kind == "preempt":
             proc_queues: List[int] = []
             seen_q: Dict[str, PriorityQueue] = {}
             under: List[int] = []
@@ -1459,6 +1596,13 @@ class _EvictPlan:
             use_prop_overused="proportion" in ssn.overused_fns,
             use_prop_queue_order="proportion" in queue_order,
         )
+        if fused and kind == "preempt":
+            self.reclaim_spec = self.spec._replace(
+                kind="reclaim", victim_fns=tuple(self.reclaim_decide))
+        self.jidx = jidx
+        self.qnames = qnames
+        self.t_real = t_real
+        self.tb = tb
         self.encode_s = time.perf_counter() - t0
 
     # -- run: dispatch once, fetch once, replay committed ops --------------
@@ -1469,16 +1613,41 @@ class _EvictPlan:
         if self.trivial:
             prof[key] = {"trivial": True}
             return True
+        from volcano_tpu.utils import devprof
+
         t0 = time.perf_counter()
         layout, bufs = _pack(self.arrays, self.kind)
         staged = _stage(bufs, prof)
         try:
-            out = np.asarray(_solve_packed(self.spec, layout, staged))
+            # async fetch (shared with the session-fused driver): the D2H
+            # copy starts at dispatch and overlaps the host-side replay
+            # scaffolding below; the wait is the action's one sync point
+            wait = devprof.start_fetch(
+                _solve_packed(self.spec, layout, staged))
+            # host bookkeeping that needs no result: bind the replay
+            # dependencies while the device still solves
+            from volcano_tpu.scheduler import metrics  # noqa: F401
+            from volcano_tpu.scheduler.util import (  # noqa: F401
+                scheduler_helper)
+
+            out = wait()
         except Exception as e:  # any device/compile failure -> old path
             logger.exception("batched %s solve failed; falling back",
                              self.kind)
             prof[key + "_fallback"] = f"solve error: {e}"
             return False
+        return self.consume(out, time.perf_counter() - t0)
+
+    def consume(self, out: np.ndarray, solve_s: float,
+                kind: Optional[str] = None) -> bool:
+        """Validate + replay a fetched packed result (shared by run() and
+        the session-fused driver — which replays BOTH evict stages through
+        one fused-encode plan, passing ``kind`` explicitly). False =>
+        nothing was applied and the caller must run the old per-action
+        path."""
+        kind = kind or self.kind
+        prof = _profile(self.ssn)
+        key = f"evict_{kind}"
         t1 = time.perf_counter()
         lr = self.log_rows
         tail = out[lr * 3:]
@@ -1499,16 +1668,16 @@ class _EvictPlan:
                     "resource underflow under panic mode"
                 return False
         log = out[:log_len * 3].reshape(log_len, 3)
-        self._replay(log, victims, attempts, rr)
+        self._replay(log, victims, attempts, rr, kind=kind)
         prof[key] = {
-            "solve_s": t1 - t0, "apply_s": time.perf_counter() - t1,
+            "solve_s": solve_s, "apply_s": time.perf_counter() - t1,
             "encode_s": self.encode_s, "ops": log_len,
             "victims": victims, "attempts": attempts,
         }
         return True
 
     def _replay(self, log: np.ndarray, victims: int, attempts: int,
-                rr: int) -> None:
+                rr: int, kind: Optional[str] = None) -> None:
         """Apply the committed op log in exact serial order through the
         real Statement/session mutators (events, cache effectors, and
         SnapshotKeeper dirty-sets all fire as the serial walk would)."""
@@ -1517,7 +1686,7 @@ class _EvictPlan:
 
         ssn = self.ssn
         v = self.v
-        if self.kind == "preempt":
+        if (kind or self.kind) == "preempt":
             stmt = None
             for kind_, a, b in log.tolist():
                 if kind_ == OP_EVICT:
@@ -1563,12 +1732,12 @@ class _BackfillPlan:
     host replays through ssn.allocate and keeps the serial-fidelity
     FitErrors machinery — including the bounded diagnostics replay."""
 
-    def __init__(self, ssn):
+    def __init__(self, ssn, view=None):
         from volcano_tpu.api import objects
 
         t0 = time.perf_counter()
         self.ssn = ssn
-        view = _common_view(ssn)
+        view = _common_view(ssn, view)
         self.view = view
         tasks: List = []
         jobs_of: List = []
@@ -1638,18 +1807,38 @@ class _BackfillPlan:
         if self.trivial:
             prof["evict_backfill"] = {"trivial": True}
             return True
+        from volcano_tpu.utils import devprof
+
         ssn = self.ssn
         t0 = time.perf_counter()
         layout, bufs = _pack(self.arrays, "backfill")
         staged = _stage(bufs, prof)
         try:
-            assign = np.asarray(_solve_packed(self.spec, layout, staged))
+            wait = devprof.start_fetch(
+                _solve_packed(self.spec, layout, staged))
+            # overlap the fetch with the replay's node-list build (the one
+            # host-side O(N) term on this action's critical path)
+            all_nodes = helper.get_node_list(ssn.nodes)
+            assign = wait()
         except Exception as e:
             logger.exception("batched backfill solve failed; falling back")
             prof["evict_backfill_fallback"] = f"solve error: {e}"
             return False
+        return self.consume(assign, time.perf_counter() - t0,
+                            all_nodes=all_nodes)
+
+    def consume(self, assign: np.ndarray, solve_s: float,
+                all_nodes=None) -> bool:
+        """Replay a fetched backfill assignment (shared by run() and the
+        session-fused driver)."""
+        from volcano_tpu.api.unschedule_info import FitErrors, FitFailure
+        from volcano_tpu.scheduler.util import scheduler_helper as helper
+
+        ssn = self.ssn
+        prof = _profile(ssn)
         t1 = time.perf_counter()
-        all_nodes = helper.get_node_list(ssn.nodes)
+        if all_nodes is None:
+            all_nodes = helper.get_node_list(ssn.nodes)
         # budget for full per-node diagnostics replay on failures — same
         # contract as the dense-view path (backfill.py replay_budget)
         replay_budget = 8
@@ -1707,7 +1896,7 @@ class _BackfillPlan:
                     "allocation" % tried)
             job.nodes_fit_errors[task.uid] = fe
         prof["evict_backfill"] = {
-            "solve_s": t1 - t0, "apply_s": time.perf_counter() - t1,
+            "solve_s": solve_s, "apply_s": time.perf_counter() - t1,
             "encode_s": self.encode_s,
             "tasks": len(self.tasks), "placed": placed,
         }

@@ -548,26 +548,23 @@ def _queue_budget(enc, queue_alloc, accept, task_rank, task_queue, task_job):
     return jnp.zeros(t_total, bool).at[order].set(accept_s)
 
 
-@functools.partial(jax.jit, static_argnames=("spec", "layout"))
-def solve_rounds_packed(spec: SolveSpec, layout, bufs):
-    """solve_rounds over packed (group x dtype-class) buffers.
-
-    The PJRT hop (a tunneled TPU here) pays a fixed RTT per transferred
-    buffer AND per fetch; the encoder emits ~46 arrays, so shipping them
-    individually costs more wall-clock than the solve itself. The solver
-    packs them into flat per-group buffers host-side (solver._pack, with a
-    device cache for unchanged groups) and this entry unpacks with static
-    slices — free under XLA fusion. The result is ONE array — assign plus
-    a PROF_TAIL-long profile tail (round-counter limbs, tail_placed,
-    full-sweep round count, capped flag, the placed-per-round histogram) —
-    so the host pays exactly one D2H round trip; int16 when the node count
-    allows (halves the downlink; assign values are node indices or -1)."""
-    enc = {
+def unpack_layout(layout, bufs):
+    """Static-slice unpack of solver._pack buffers into the enc dict —
+    free under XLA fusion; shared by the packed entry below and the
+    session-fused allocate stage (ops/session_fuse.py)."""
+    return {
         name: lax.slice_in_dim(bufs[key], off, off + size).reshape(shape)
         for name, key, off, size, shape in layout
     }
-    (assign, n_rounds, tail_placed, full_sweeps, capped,
-     placed_hist) = solve_rounds.__wrapped__(spec, enc)
+
+
+def pack_result(enc, raw):
+    """Pack a solve_rounds result tuple into the ONE fetchable array:
+    assign plus a PROF_TAIL-long profile tail (round-counter limbs,
+    tail_placed, full-sweep round count, capped flag, the placed-per-round
+    histogram); int16 when the node count allows (halves the downlink —
+    assign values are node indices or -1/-2)."""
+    (assign, n_rounds, tail_placed, full_sweeps, capped, placed_hist) = raw
     n_total = enc["node_idle"].shape[0]
     # tail_placed is bounded by 8*round_min_progress+16; clamp everything to
     # the int16 limb's range so an extreme config can't silently wrap a
@@ -582,6 +579,22 @@ def solve_rounds_packed(spec: SolveSpec, layout, bufs):
         return jnp.concatenate([assign.astype(jnp.int16),
                                 tail.astype(jnp.int16)])
     return jnp.concatenate([assign, tail])
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "layout"))
+def solve_rounds_packed(spec: SolveSpec, layout, bufs):
+    """solve_rounds over packed (group x dtype-class) buffers.
+
+    The PJRT hop (a tunneled TPU here) pays a fixed RTT per transferred
+    buffer AND per fetch; the encoder emits ~46 arrays, so shipping them
+    individually costs more wall-clock than the solve itself. The solver
+    packs them into flat per-group buffers host-side (solver._pack, with a
+    device cache for unchanged groups) and this entry unpacks with static
+    slices — free under XLA fusion. The result is ONE array (pack_result)
+    so the host pays exactly one D2H round trip."""
+    enc = unpack_layout(layout, bufs)
+    raw = solve_rounds.__wrapped__(spec, enc)
+    return pack_result(enc, raw)
 
 
 @functools.partial(jax.jit, static_argnames=("spec",))

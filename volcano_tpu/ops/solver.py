@@ -332,9 +332,14 @@ class BatchAllocator:
             out[k] = jax.device_put(v, sh)
         return out
 
-    def __call__(self, ssn) -> bool:
-        from volcano_tpu.scheduler.util import scheduler_helper
+    def _prepare(self, ssn):
+        """Encode + gate + (rounds, no-mesh) pack/stage, WITHOUT dispatching.
 
+        Returns a dict bundle consumed by __call__ — and by the session-
+        fused driver (ops/session_fuse.py), which dispatches the same
+        spec/layout/staged through its own chained program — or None after
+        recording the fallback reason in the profile (the caller then runs
+        the serial loop)."""
         t0 = time.perf_counter()
         if self.mode in ("rounds", "auto"):
             # the bulk writeback (_apply_bulk) bypasses the Statement event
@@ -352,7 +357,7 @@ class BatchAllocator:
             if unknown:
                 self.profile["fallback"] = (
                     f"rounds apply cannot honor custom plugins: {sorted(unknown)}")
-                return False
+                return None
         try:
             # rounds mode tolerates un-modeled constructs as a serial
             # residue (affinity/port tasks stay PENDING; releasing capacity
@@ -363,7 +368,7 @@ class BatchAllocator:
         except EncoderFallback as e:
             logger.info("tpuscore falling back to serial allocate: %s", e)
             self.profile["fallback"] = str(e)
-            return False
+            return None
         t, n, j, *_ = enc.shape
         if t == 0 or n == 0 or j == 0:
             # nothing for the device to place (possibly everything pending
@@ -372,7 +377,7 @@ class BatchAllocator:
                 self.profile["fallback"] = (
                     f"all {enc.residue_count} pending tasks are residue "
                     f"(affinity/ports); serial loop handles them")
-            return False
+            return None
 
         mode = self.mode
         if mode == "auto":
@@ -380,7 +385,7 @@ class BatchAllocator:
                 self.profile["fallback"] = (
                     f"auto: {t} tasks below rounds threshold; serial loop "
                     f"is cheaper than a device hop")
-                return False
+                return None
             mode = "rounds"
 
         try:
@@ -391,6 +396,8 @@ class BatchAllocator:
             if self.mesh is not None:
                 arrays = self._shard(arrays)
             t1 = time.perf_counter()
+            prep = dict(mode=mode, enc=enc, arrays=arrays, t0=t0, t1=t1,
+                        spec=None, layout=None, staged=None, pack_s=0.0)
 
             if mode == "rounds":
                 from volcano_tpu.ops import rounds as rounds_mod
@@ -416,6 +423,8 @@ class BatchAllocator:
                     # sweep) and typically halves the tail
                     straggler_rounds=4 if kb > rounds_mod.CHUNK else 0,
                     window_k=wf["window_k"], dirty_k=wf["dirty_k"])
+                prep["spec"] = spec
+                prep["arrays"] = rounds_arrays
                 if self.mesh is None:
                     # grouped packed transfer + device cache: unchanged
                     # groups never re-cross the (tunneled) PJRT hop, and the
@@ -423,53 +432,116 @@ class BatchAllocator:
                     # limbs) so the session pays a single D2H round trip
                     layout, bufs = _pack(rounds_arrays)
                     staged = _stage(bufs, self.profile)
+                    prep["layout"] = layout
+                    prep["staged"] = staged
+                    prep["pack_s"] = time.perf_counter() - t1
+        except Exception as e:  # any device/compile failure -> serial oracle
+            logger.exception("tpuscore prepare failed; falling back to serial")
+            self.profile["fallback"] = f"solve error: {e}"
+            return None
+        return prep
+
+    def parse_packed(self, out: np.ndarray):
+        """Split the packed single-fetch result into (assign, meta dict)."""
+        from volcano_tpu.ops import rounds as rounds_mod
+
+        pt = rounds_mod.PROF_TAIL
+        assign = out[:-pt].astype(np.int32, copy=False)
+        meta = out[-pt:].astype(np.int64)
+        return assign, dict(
+            n_rounds=int(meta[0]) | (int(meta[1]) << 15),
+            tail_placed=int(meta[2]),
+            full_sweeps=int(meta[3]),
+            round_capped=bool(meta[4]),
+            placed_hist=meta[5:],
+        )
+
+    def apply_packed(self, ssn, prep: dict, assign: np.ndarray,
+                     meta: dict) -> bool:
+        """Profile + bulk-apply a rounds result (shared by the per-action
+        dispatch below and the session-fused driver, so both land identical
+        session state and profile keys)."""
+        from volcano_tpu.ops import rounds as rounds_mod
+
+        enc = prep["enc"]
+        spec = prep["spec"]
+        self.profile["rounds"] = int(meta["n_rounds"])
+        # candidate-window round profile: how many rounds needed the
+        # full-width exactness fallback, the jit-static window/dirty
+        # buckets, and the placed-per-round histogram (clamped to
+        # PROF_SLOTS slots, values to the int16 limb)
+        self.profile["full_sweep_rounds"] = meta["full_sweeps"]
+        self.profile["window_k"] = spec.window_k
+        self.profile["dirty_k"] = spec.dirty_k
+        self.profile["round_capped"] = meta["round_capped"]
+        self.profile["round_placed"] = [
+            int(x) for x in meta["placed_hist"][
+                :min(int(meta["n_rounds"]), rounds_mod.PROF_SLOTS)]]
+        # always emitted (0 when the tail never ran) so bench
+        # consumers need no existence checks. This is a count of
+        # tail placement ATTEMPTS: the post-tail gang-atomicity
+        # strip may later revoke placements of gangs that stayed
+        # short, and those revocations are not subtracted here —
+        # treat as an upper bound on tail contribution, not a net
+        # figure
+        self.profile["tail_placed"] = meta["tail_placed"]
+        t2 = time.perf_counter()
+        self.profile["mode"] = "rounds"
+        self._apply_bulk(ssn, enc, assign)
+        t3 = time.perf_counter()
+        t, n, j, *_ = enc.shape
+        self.profile.update(
+            encode_s=prep["t1"] - prep["t0"], solve_s=t2 - prep["t1"],
+            apply_s=t3 - t2,
+            tasks=t, nodes=n, jobs=j,
+            placed=int((assign[: len(enc.task_infos)] >= 0).sum()),
+            residue=enc.residue_count,
+            has_releasing=enc.has_releasing,
+        )
+        return True
+
+    def __call__(self, ssn) -> bool:
+        from volcano_tpu.scheduler.util import scheduler_helper
+        from volcano_tpu.utils import devprof
+
+        prep = self._prepare(ssn)
+        if prep is None:
+            return False
+        mode = prep["mode"]
+        enc = prep["enc"]
+        t1 = prep["t1"]
+        try:
+            if mode == "rounds":
+                from volcano_tpu.ops import rounds as rounds_mod
+
+                if self.mesh is None:
                     tp = time.perf_counter()
-                    out = np.asarray(rounds_mod.solve_rounds_packed(
-                        spec, layout, staged))
-                    pt = rounds_mod.PROF_TAIL
-                    assign = out[:-pt].astype(np.int32, copy=False)
-                    meta = out[-pt:].astype(np.int64)
-                    n_rounds = int(meta[0]) | (int(meta[1]) << 15)
-                    tail_placed = int(meta[2])
-                    full_sweeps = int(meta[3])
-                    round_capped = bool(meta[4])
-                    placed_hist = meta[5:]
-                    self.profile["pack_s"] = tp - t1
+                    # async fetch: the copy starts at dispatch, and the
+                    # wait is the session's counted sync point (devprof)
+                    wait = devprof.start_fetch(rounds_mod.solve_rounds_packed(
+                        prep["spec"], prep["layout"], prep["staged"]))
+                    out = wait()
+                    self.profile["pack_s"] = prep["pack_s"]
                     self.profile["dispatch_s"] = time.perf_counter() - tp
+                    assign, meta = self.parse_packed(out)
                 else:
                     # mesh path keeps per-array puts: node-axis arrays carry
                     # NamedShardings that packing would destroy
                     (assign, n_rounds, tail_placed, full_sweeps,
                      round_capped, placed_hist) = rounds_mod.solve_rounds(
-                        spec, rounds_arrays)
-                    tail_placed = int(tail_placed)
-                    full_sweeps = int(full_sweeps)
-                    round_capped = bool(round_capped)
-                    placed_hist = np.asarray(placed_hist)
+                        prep["spec"], prep["arrays"])
+                    assign = np.asarray(assign)
+                    meta = dict(
+                        n_rounds=int(n_rounds),
+                        tail_placed=int(tail_placed),
+                        full_sweeps=int(full_sweeps),
+                        round_capped=bool(round_capped),
+                        placed_hist=np.asarray(placed_hist))
                 assign = np.asarray(assign)
-                self.profile["rounds"] = int(n_rounds)
-                # candidate-window round profile: how many rounds needed the
-                # full-width exactness fallback, the jit-static window/dirty
-                # buckets, and the placed-per-round histogram (clamped to
-                # PROF_SLOTS slots, values to the int16 limb)
-                self.profile["full_sweep_rounds"] = full_sweeps
-                self.profile["window_k"] = spec.window_k
-                self.profile["dirty_k"] = spec.dirty_k
-                self.profile["round_capped"] = round_capped
-                self.profile["round_placed"] = [
-                    int(x) for x in placed_hist[
-                        :min(int(n_rounds), rounds_mod.PROF_SLOTS)]]
-                # always emitted (0 when the tail never ran) so bench
-                # consumers need no existence checks. This is a count of
-                # tail placement ATTEMPTS: the post-tail gang-atomicity
-                # strip may later revoke placements of gangs that stayed
-                # short, and those revocations are not subtracted here —
-                # treat as an upper bound on tail contribution, not a net
-                # figure
-                self.profile["tail_placed"] = tail_placed
             else:
                 assign, rr = kernels.solve_allocate(
-                    enc.spec, arrays, np.int32(enc.rr0), np.int32(enc.num_to_find)
+                    enc.spec, prep["arrays"], np.int32(enc.rr0),
+                    np.int32(enc.num_to_find)
                 )
                 assign = np.asarray(assign)
                 # round-robin index continues across sessions exactly like
@@ -479,16 +551,16 @@ class BatchAllocator:
             logger.exception("tpuscore solve failed; falling back to serial")
             self.profile["fallback"] = f"solve error: {e}"
             return False
-        t2 = time.perf_counter()
-        self.profile["mode"] = mode
 
         if mode == "rounds":
-            self._apply_bulk(ssn, enc, assign)
-        else:
-            self._apply(ssn, enc, assign)
+            return self.apply_packed(ssn, prep, assign, meta)
+        t2 = time.perf_counter()
+        self.profile["mode"] = mode
+        self._apply(ssn, enc, assign)
         t3 = time.perf_counter()
+        t, n, j, *_ = enc.shape
         self.profile.update(
-            encode_s=t1 - t0, solve_s=t2 - t1, apply_s=t3 - t2,
+            encode_s=t1 - prep["t0"], solve_s=t2 - t1, apply_s=t3 - t2,
             tasks=t, nodes=n, jobs=j,
             placed=int((assign[: len(enc.task_infos)] >= 0).sum()),
             residue=enc.residue_count,

@@ -30,6 +30,48 @@ from volcano_tpu.scheduler.util.priority_queue import (
 logger = logging.getLogger(__name__)
 
 
+def finish_batched(ssn, solver) -> None:
+    """Post-bulk bookkeeping after a successful batched solve: residue
+    profile keys + the serial residue pass. Shared by the per-action
+    execute below and the session-fused driver (ops/session_fuse.py), so
+    both land identical residue semantics and profile keys."""
+    prof = solver.profile
+    # residue-family keys are always present (0 when the serial
+    # residue pass never ran) so bench consumers need no
+    # existence checks
+    prof.setdefault("residue_pass_ms", 0.0)
+    prof.setdefault("residue_pass_tasks", 0)
+    residue = prof.get("residue", 0)
+    unplaced = prof.get("tasks", 0) - prof.get("placed", 0)
+    if residue or (prof.get("has_releasing") and unplaced):
+        # serial residue pass: tasks the device solve does not model
+        # (pod affinity, host ports) are still PENDING, and nodes
+        # with releasing capacity can still pipeline leftovers; the
+        # serial loop picks up exactly the remaining pending tasks
+        # on post-bulk state with full predicate fidelity. The dense
+        # alloc assist (vectorized window + cached score rows, live
+        # residual affinity/ports checks) replaces the per-node
+        # closure sweeps with bit-identical selections.
+        import time
+
+        from volcano_tpu.ops import preemptview
+
+        logger.info(
+            "allocate: serial residue pass (%d residue tasks, "
+            "%d unplaced)", residue, unplaced)
+        t0 = time.perf_counter()
+        AllocateAction()._serial_execute(
+            ssn, assist=preemptview.build_alloc_assist(ssn))
+        # the tail the device solve left to the host, as first-class
+        # profile terms (bench: tpu_residue_ms / tpu_residue_tasks)
+        # — the candidate-window straggler rounds exist to shrink
+        # exactly these numbers
+        prof["residue_pass_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 3)
+        prof["residue_pass_tasks"] = residue + (
+            unplaced if prof.get("has_releasing") else 0)
+
+
 class AllocateAction(Action):
     def name(self) -> str:
         return "allocate"
@@ -40,41 +82,7 @@ class AllocateAction(Action):
         # serial loop below remains the fallback and oracle.
         solver = getattr(ssn, "batch_allocator", None)
         if solver is not None and solver(ssn):
-            prof = solver.profile
-            # residue-family keys are always present (0 when the serial
-            # residue pass never ran) so bench consumers need no
-            # existence checks
-            prof.setdefault("residue_pass_ms", 0.0)
-            prof.setdefault("residue_pass_tasks", 0)
-            residue = prof.get("residue", 0)
-            unplaced = prof.get("tasks", 0) - prof.get("placed", 0)
-            if residue or (prof.get("has_releasing") and unplaced):
-                # serial residue pass: tasks the device solve does not model
-                # (pod affinity, host ports) are still PENDING, and nodes
-                # with releasing capacity can still pipeline leftovers; the
-                # serial loop picks up exactly the remaining pending tasks
-                # on post-bulk state with full predicate fidelity. The dense
-                # alloc assist (vectorized window + cached score rows, live
-                # residual affinity/ports checks) replaces the per-node
-                # closure sweeps with bit-identical selections.
-                import time
-
-                from volcano_tpu.ops import preemptview
-
-                logger.info(
-                    "allocate: serial residue pass (%d residue tasks, "
-                    "%d unplaced)", residue, unplaced)
-                t0 = time.perf_counter()
-                self._serial_execute(
-                    ssn, assist=preemptview.build_alloc_assist(ssn))
-                # the tail the device solve left to the host, as first-class
-                # profile terms (bench: tpu_residue_ms / tpu_residue_tasks)
-                # — the candidate-window straggler rounds exist to shrink
-                # exactly these numbers
-                prof["residue_pass_ms"] = round(
-                    (time.perf_counter() - t0) * 1e3, 3)
-                prof["residue_pass_tasks"] = residue + (
-                    unplaced if prof.get("has_releasing") else 0)
+            finish_batched(ssn, solver)
             return
         self._serial_execute(ssn)
 

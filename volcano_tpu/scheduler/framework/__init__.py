@@ -12,4 +12,8 @@ from volcano_tpu.scheduler.framework.arguments import Arguments
 from volcano_tpu.scheduler.framework.event_handlers import Event, EventHandler
 from volcano_tpu.scheduler.framework.session import Session
 from volcano_tpu.scheduler.framework.statement import Statement
-from volcano_tpu.scheduler.framework.framework import open_session, close_session
+from volcano_tpu.scheduler.framework.framework import (
+    open_session,
+    close_session,
+    run_actions,
+)

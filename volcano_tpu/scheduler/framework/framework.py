@@ -44,6 +44,32 @@ def open_session(cache, tiers: List[conf.Tier]) -> Session:
     return ssn
 
 
+def run_actions(ssn: Session, actions) -> dict:
+    """Run the session's action chain, preferring the whole-session fused
+    dispatch (ops/session_fuse.py) when the session is inside its envelope;
+    otherwise the plain per-action loop. ``actions`` is a sequence of
+    action names or Action instances. Returns {action name: wall ms} — the
+    per-action timings every caller (scheduler loop, bench, simulator) used
+    to collect itself."""
+    from volcano_tpu.scheduler.framework.plugins import get_action
+
+    names = [a if isinstance(a, str) else a.name() for a in actions]
+    try:
+        from volcano_tpu.ops import session_fuse
+    except Exception:  # pragma: no cover - jax-free host
+        session_fuse = None
+    if session_fuse is not None:
+        out = session_fuse.try_run(ssn, names)
+        if out is not None:
+            return out
+    action_ms = {}
+    for name in names:
+        t0 = time.perf_counter()
+        get_action(name).execute(ssn)
+        action_ms[name] = round((time.perf_counter() - t0) * 1e3, 3)
+    return action_ms
+
+
 def close_session(ssn: Session) -> None:
     # apply any cache-mirror work the bulk writeback deferred off the
     # in-session critical path (solver._apply_bulk; the reference's bind

@@ -24,6 +24,7 @@ from volcano_tpu.scheduler.framework import (
     close_session,
     get_action,
     open_session,
+    run_actions,
 )
 
 logger = logging.getLogger(__name__)
@@ -213,11 +214,11 @@ class Scheduler:
 
         ssn = open_session(self.cache, self.tiers)
         try:
-            for action in self.actions:
-                t0 = time.perf_counter()
-                action.execute(ssn)
-                metrics.update_action_duration(
-                    action.name(), time.perf_counter() - t0)
+            # fused whole-session dispatch when the session qualifies
+            # (ops/session_fuse.py), per-action loop otherwise
+            action_ms = run_actions(ssn, self.actions)
+            for name, ms in action_ms.items():
+                metrics.update_action_duration(name, ms / 1e3)
         finally:
             close_session(ssn)
         metrics.update_e2e_duration(time.perf_counter() - start)

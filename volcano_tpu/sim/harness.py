@@ -37,7 +37,8 @@ from volcano_tpu.controllers.queue import QueueController
 from volcano_tpu.scheduler import metrics
 from volcano_tpu.scheduler.cache import SchedulerCache
 from volcano_tpu.scheduler.cache.cache import DefaultBinder, DefaultEvictor
-from volcano_tpu.scheduler.framework import close_session, open_session
+from volcano_tpu.scheduler.framework import (
+    close_session, open_session, run_actions)
 from volcano_tpu.scheduler.scheduler import (
     DEFAULT_SCHEDULER_CONF,
     TPU_SCHEDULER_CONF,
@@ -57,15 +58,23 @@ _CONF_BY_NAME = {"tpu": TPU_SCHEDULER_CONF, "default": DEFAULT_SCHEDULER_CONF}
 class _CountingBinder(DefaultBinder):
     """DefaultBinder + a shared bind tally (the auditor's event-vs-bind
     consistency base). Counters live on the sim, so scheduler restarts
-    (fresh binder) keep one continuous series."""
+    (fresh binder) keep one continuous series. With a clock fn it also
+    records each pod's submit->bind wait in VIRTUAL seconds — the
+    latency the storm headline (sessions/sec + p99 task wait) binds on."""
 
-    def __init__(self, store: Store, counters: Dict[str, int]):
+    def __init__(self, store: Store, counters: Dict[str, int],
+                 now_fn=None, waits: Optional[List[float]] = None):
         super().__init__(store)
         self._counters = counters
+        self._now = now_fn
+        self._waits = waits
 
     def bind(self, pod, hostname: str) -> None:
         super().bind(pod, hostname)
         self._counters["binds"] += 1
+        if self._now is not None and self._waits is not None:
+            created = getattr(pod.metadata, "creation_timestamp", 0.0) or 0.0
+            self._waits.append(max(self._now() - created, 0.0))
 
 
 class _CountingEvictor(DefaultEvictor):
@@ -107,6 +116,9 @@ class SimCluster:
         self.store = Store()
         admission.install(self.store, "volcano", gate_pods=True)
         self.counters: Dict[str, int] = {"binds": 0, "evictions": 0}
+        # submit->bind latency per pod, virtual seconds (storm headline);
+        # created before the scheduler build, which hands it to the binder
+        self._task_wait_s: List[float] = []
         self._build_controllers()
         self._build_scheduler()
         self.mirrors = [
@@ -149,7 +161,9 @@ class SimCluster:
         self.actions, self.tiers = load_scheduler_conf(conf_str)
         self.cache = SchedulerCache(
             store=self.store,
-            binder=_CountingBinder(self.store, self.counters),
+            binder=_CountingBinder(self.store, self.counters,
+                                   now_fn=self.vclock.now,
+                                   waits=self._task_wait_s),
             evictor=_CountingEvictor(self.store, self.counters))
         self.cache.run()
         self.cache.wait_for_cache_sync()
@@ -194,8 +208,8 @@ class SimCluster:
         t0 = time.perf_counter()
         ssn = open_session(self.cache, self.tiers)
         t1 = time.perf_counter()
-        for action in self.actions:
-            action.execute(ssn)
+        # fused whole-session dispatch when the session qualifies
+        run_actions(ssn, self.actions)
         t2 = time.perf_counter()
         if kill:
             # crash inside the defer window: actions ran (binds hit the
@@ -325,6 +339,7 @@ class SimCluster:
             "sessions_per_sec": round(self.sessions_done / wall_s, 3)
             if wall_s > 0 else 0.0,
             "session_ms": _percentiles(self._e2e_ms),
+            "task_wait_s": _percentiles(self._task_wait_s),
             "phase_ms": {
                 "open": _percentiles(self._open_ms),
                 "actions": _percentiles(self._actions_ms),

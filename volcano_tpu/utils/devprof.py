@@ -1,0 +1,151 @@
+"""Device-interaction profiler: sync points, D2H fetches, overlap wall.
+
+Nothing in volcano_tpu ever fenced the device before PR 6: `dispatch_s` /
+`solve_s` windows conflated queueing with compute (jax dispatch is async on
+every backend), and the bench floor probe measured whatever the runtime
+happened to flush. This module is the ONE place host<->device
+synchronization happens so it can be counted:
+
+- ``start_fetch(x)`` begins the D2H copy immediately (``copy_to_host_async``
+  when the array supports it) and returns a wait closure; the span between
+  the two calls is host work OVERLAPPED with device compute/transfer and is
+  accumulated into ``overlap_s``. The wait itself is a counted sync point.
+- ``fence(x=None)`` is an explicit ``block_until_ready`` barrier — with no
+  argument it drains every in-flight array registered by ``start_fetch``.
+  The bench places these around the floor probe and each warm sample so a
+  timed window can never inherit queued work from its predecessor.
+- ``session(profile)`` scopes the counters to one scheduler session; the
+  collector lands ``tpu_sync_points`` / ``tpu_d2h_fetches`` /
+  ``tpu_overlap_ms`` in the session profile.
+
+The counters are honest only because every dispatch site in ops/ routes its
+fetch through here (vclint VT006 guards the donation half of the contract).
+Single-threaded by design, like the session loop that owns it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+# the active collector (one scheduler session at a time); counters are
+# module-level so call sites need no plumbing through the action stack
+_active: Optional[dict] = None
+
+# in-flight device arrays with pending fetches/dispatches, for fence();
+# entries are dropped once waited on
+_inflight: List = []
+
+
+class _Collector(object):
+    """Context manager installing a per-session counter dict."""
+
+    def __init__(self, profile: dict):
+        self.profile = profile
+        self._prev: Optional[dict] = None
+
+    def __enter__(self) -> dict:
+        global _active
+        self._prev = _active
+        _active = {"sync_points": 0, "d2h_fetches": 0, "overlap_s": 0.0,
+                   "fence_wait_s": 0.0}
+        return _active
+
+    def __exit__(self, *exc) -> None:
+        global _active
+        counters, _active = _active, self._prev
+        if counters is not None and self.profile is not None:
+            self.profile["tpu_sync_points"] = counters["sync_points"]
+            self.profile["tpu_d2h_fetches"] = counters["d2h_fetches"]
+            self.profile["tpu_overlap_ms"] = round(
+                counters["overlap_s"] * 1e3, 3)
+            self.profile["tpu_fence_wait_ms"] = round(
+                counters["fence_wait_s"] * 1e3, 3)
+
+
+def session(profile: dict) -> _Collector:
+    """Scope the counters to one session; results land in ``profile``."""
+    return _Collector(profile)
+
+
+def counters() -> Optional[dict]:
+    """The live counter dict, or None outside any session scope."""
+    return _active
+
+
+def start_fetch(x) -> Callable[[], np.ndarray]:
+    """Begin fetching device array ``x``; returns wait() -> np.ndarray.
+
+    The copy starts NOW (overlapping whatever host work runs before wait),
+    and the wait is the session's counted sync point. Works on plain
+    numpy/host arrays too (wait degenerates to np.asarray) so callers never
+    need a backend check.
+    """
+    t0 = time.perf_counter()
+    if _active is not None:
+        _active["d2h_fetches"] += 1
+    copy_async = getattr(x, "copy_to_host_async", None)
+    if copy_async is not None:
+        try:
+            copy_async()
+        except Exception:  # pragma: no cover - backend without async copy
+            pass
+    _inflight.append(x)
+
+    def wait() -> np.ndarray:
+        t1 = time.perf_counter()
+        out = np.asarray(x)
+        if _active is not None:
+            _active["sync_points"] += 1
+            _active["overlap_s"] += t1 - t0
+            _active["fence_wait_s"] += time.perf_counter() - t1
+        try:
+            _inflight.remove(x)
+        except ValueError:  # pragma: no cover - double wait
+            pass
+        return out
+
+    return wait
+
+
+def register(x) -> None:
+    """Track a dispatched array so a later fence() drains it (for results
+    that are consumed device-side rather than fetched)."""
+    _inflight.append(x)
+
+
+def fence(x=None) -> None:
+    """Explicit block_until_ready barrier (a counted sync point).
+
+    With an argument, blocks on that array/pytree; with none, drains every
+    registered in-flight array. Placed only at profiling/apply boundaries —
+    the overlap scheme depends on everything else staying async.
+    """
+    t0 = time.perf_counter()
+    blocked = False
+    targets = [x] if x is not None else list(_inflight)
+    for t in targets:
+        block = getattr(t, "block_until_ready", None)
+        try:
+            if block is not None:
+                block()
+            else:
+                np.asarray(t)
+            blocked = True
+        except Exception:  # pragma: no cover - deleted/donated buffers
+            pass
+        if x is None:
+            try:
+                _inflight.remove(t)
+            except ValueError:  # pragma: no cover
+                pass
+    if _active is not None and blocked:
+        _active["sync_points"] += 1
+        _active["fence_wait_s"] += time.perf_counter() - t0
+
+
+def drain() -> None:
+    """fence() alias for bench call sites: drain all in-flight work."""
+    fence(None)

@@ -334,6 +334,113 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
 _GC_POLICY = None
 
 
+def run_express(scale: float, arrivals: int = 96, rate_per_s: float = 50.0,
+                warm: int = 16, seed: int = 7):
+    """--express: Poisson interactive arrivals against a warm cfg5-scale
+    snapshot, through the event-driven express lane (volcano_tpu/express).
+
+    One full session settles the backlog first (warm cfg5 snapshot), then
+    each iteration submits the arrivals one ~20 ms service period accrued
+    (Poisson at `rate_per_s`) and services the lane once. The first
+    `warm` iterations absorb compiles and are excluded from the latency
+    percentiles (recorded separately); the measured iterations must not
+    retrace — `express_warm_compiles` is the proof, exactly the
+    assert_no_compiles contract the tests pin. After the arrival storm, a
+    full session reconciles and the confirm/revert counts land in the
+    record. The PR 6 devprof counters attribute every express-path sync
+    point."""
+    import random
+    import statistics
+
+    from volcano_tpu.api import objects
+    from volcano_tpu.bench.clusters import build_config
+    from volcano_tpu.express import ExpressLane
+    from volcano_tpu.scheduler.util.test_utils import (
+        build_pod, build_pod_group)
+
+    cache, _, tpu_tiers, actions, n_tasks = build_config(5, scale)
+    lane = ExpressLane(cache)
+    settle = _session_once(cache, tpu_tiers, actions)
+    lane.run_once()  # drain the backlog notifications (all ineligible/bound)
+
+    rng = random.Random(seed)
+    period_s = 0.02
+    counter = [0]
+
+    def submit_burst():
+        """Arrivals accrued over one service period of the Poisson
+        process (>= 1 so every iteration measures a real batch)."""
+        n = 0
+        budget = period_s
+        while True:
+            gap = rng.expovariate(rate_per_s)
+            if gap > budget and n > 0:
+                break
+            budget -= gap
+            n += 1
+        for _ in range(max(n, 1)):
+            counter[0] += 1
+            pg = f"xpr-{counter[0]:05d}"
+            cache.add_pod_group(build_pod_group(
+                pg, namespace="express", min_member=1))
+            cache.add_pod(build_pod(
+                "express", f"{pg}-t0", "", objects.POD_PHASE_PENDING,
+                {"cpu": f"{rng.choice([100, 250])}m",
+                 "memory": rng.choice(["128Mi", "256Mi"])}, pg))
+        return max(n, 1)
+
+    try:
+        from volcano_tpu.utils.jaxcompile import CompileWatcher
+
+        watcher = CompileWatcher.install()
+    except Exception:
+        watcher = None
+    lat_ms = []
+    warm_lat_ms = []
+    sync_points = 0
+    batch_sizes = []
+    win = None
+    for it in range(arrivals + warm):
+        if it == warm and watcher is not None:
+            win = watcher.window()
+        batch_sizes.append(submit_burst())
+        rep = lane.run_once()
+        (lat_ms if it >= warm else warm_lat_ms).append(rep["ms"])
+        if it >= warm:
+            sync_points += rep["profile"].get("tpu_sync_points", 0)
+    compiles = win.delta().compiles if win is not None else None
+
+    # the reconciling full session: every optimistic bind gets a verdict
+    _session_once(cache, tpu_tiers, actions)
+
+    ordered = sorted(lat_ms)
+
+    def pick(q):
+        return round(ordered[min(int(q * len(ordered)), len(ordered) - 1)], 3)
+
+    return {
+        "scale": scale,
+        "snapshot_tasks": n_tasks,
+        "settle_session_ms": round(settle["e2e_s"] * 1e3, 3),
+        "arrivals": counter[0],
+        "batches": len(lat_ms),
+        "mean_batch": round(statistics.mean(batch_sizes), 2),
+        "tpu_express_p50_ms": pick(0.50),
+        "tpu_express_p99_ms": pick(0.99),
+        "tpu_express_max_ms": round(ordered[-1], 3),
+        "tpu_express_warm_max_ms": round(max(warm_lat_ms), 3)
+        if warm_lat_ms else 0.0,
+        "express_placed": lane.counters["placed"],
+        "express_deferred": lane.counters["deferred"],
+        "express_reconciled": lane.counters["reconciled"],
+        "express_reverted": lane.counters["reverted"],
+        "express_warm_compiles": compiles,
+        "express_sync_points_per_batch": round(
+            sync_points / max(len(lat_ms), 1), 3),
+        "express_state": dict(lane.state.stats),
+    }
+
+
 def _storm_headline(scale: float, seed: int = 7, duration: float = 60.0):
     """cfg5_storm sustained-throughput headline from the sim harness: the
     scheduler loop driven by Poisson arrivals instead of isolated warm
@@ -470,6 +577,15 @@ def main() -> int:
                          "built-in configs")
     ap.add_argument("--mesh", action="store_true",
                     help="shard the node axis across all local devices")
+    ap.add_argument("--express", action="store_true",
+                    help="express-lane mode: Poisson interactive arrivals "
+                         "against a warm cfg5-scale snapshot; records "
+                         "tpu_express_p50/p99_ms and the placed/deferred/"
+                         "reconciled/reverted counts, then exits")
+    ap.add_argument("--express-arrivals", type=int, default=96,
+                    help="measured express batches (after 16 warmup)")
+    ap.add_argument("--express-rate", type=float, default=50.0,
+                    help="Poisson arrival rate for --express, jobs/sec")
     ap.add_argument("--no-storm", action="store_true",
                     help="skip the cfg5_storm sustained sessions/sec + p99 "
                          "task-wait headline (runs only in all-configs mode)")
@@ -479,6 +595,19 @@ def main() -> int:
     ap.add_argument("--storm-duration", type=float, default=60.0,
                     help="cfg5_storm simulated horizon, seconds")
     args = ap.parse_args()
+
+    if args.express:
+        result = run_express(args.scale, arrivals=args.express_arrivals,
+                             rate_per_s=args.express_rate)
+        print(json.dumps({
+            "metric": "express placement latency p99 (ms) @ cfg5 x %s"
+                      % args.scale,
+            "value": result["tpu_express_p99_ms"],
+            "unit": "ms",
+        }), flush=True)
+        print(json.dumps({"summary": {"express": result}},
+                         separators=(",", ":")), flush=True)
+        return 0
 
     mesh = None
     if args.mesh:

@@ -53,3 +53,14 @@ def evict_dispatch(vic_rows, jobs, spec):
     v = _bucket(len(vic_rows[0]))
     vic_req = np.zeros((8, v, 2))
     return solve_preempt(spec, {"vic_req": vic_req})
+
+
+def express_dispatch(batch, jobs, n_nodes):
+    # express buckets off the same ladder: repeat arrivals of any size up
+    # to the bucket reuse one compiled program, and the candidate window
+    # comes from the blessed ladder helper
+    tb = _bucket(len(batch))
+    jb = _bucket(len(jobs))
+    spec = ExpressSpec(tb=tb, jb=jb, window_k=window_for(n_nodes, tb))
+    req = np.zeros((tb, 2))
+    return solve_express(spec, req)

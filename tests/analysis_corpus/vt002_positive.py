@@ -43,3 +43,12 @@ def evict_dispatch(vic_rows, jobs, spec):
     vic_req = np.zeros((8, v, 2))  # vclint-expect: VT002
     spec2 = EvictSpec(kind="preempt", log_rows=len(jobs))  # vclint-expect: VT002
     return solve_preempt(spec2, {"vic_req": vic_req})  # vclint-expect: VT002
+
+
+def express_dispatch(batch, jobs, dev):
+    # express batch axes are jit-static exactly like the rounds buckets: a
+    # raw arrival count re-keys the express program on every batch size
+    t = len(batch)
+    spec = ExpressSpec(tb=t, jb=len(jobs), window_k=t * 4)  # vclint-expect: VT002
+    req = np.zeros((t, 2))  # vclint-expect: VT002
+    return solve_express(spec, req)  # vclint-expect: VT002

@@ -214,6 +214,37 @@ class TestObservabilityEndpoints:
         # e2e histogram (no labels) also carries its +Inf bucket
         metrics.reset()
 
+    def test_metrics_express_series(self):
+        """Express-lane counters + latency histogram on /metrics: the
+        placements/reverted/deferred totals and the latency series with
+        its mandatory le=\"+Inf\" bucket."""
+        metrics.reset()
+        metrics.register_express_placements(5)
+        metrics.register_express_reverted(2)
+        metrics.register_express_deferred(3)
+        metrics.observe_express_latency(0.002)
+        metrics.observe_express_latency(0.004)
+        srv = ObservabilityServer(":0").start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=5).read().decode()
+        finally:
+            srv.stop()
+        lines = body.splitlines()
+        assert "# TYPE volcano_express_placements_total counter" in lines
+        assert "volcano_express_placements_total 5.0" in lines
+        assert "volcano_express_reverted_total 2.0" in lines
+        assert "volcano_express_deferred_total 3.0" in lines
+        h = "volcano_express_latency_seconds"
+        assert f"# TYPE {h} histogram" in lines
+        assert f"{h}_count 2" in lines
+        assert f'{h}_bucket{{le="+Inf"}} 2' in lines
+        # sub-10 ms envelope is resolvable: both observations land at or
+        # below the 0.005 bucket
+        assert f'{h}_bucket{{le="0.005"}} 2' in lines
+        metrics.reset()
+
     def test_healthz(self):
         healthy = {"ok": True}
         srv = ObservabilityServer(

@@ -116,3 +116,30 @@ class TestSchedulerDriver:
             assert cache.binder.wait_for_binds(2, timeout=10.0)
         finally:
             s.stop()
+
+    def test_express_loop_places_between_sessions(self):
+        """Scheduler(express=True): an eligible arrival binds through the
+        express lane during the inter-cycle wait — well before the next
+        periodic session would have run — and the following session
+        confirms it."""
+        cache = make_cache()
+        cache.add_node(build_node(
+            "n1", build_resource_list_with_pods("8", "16Gi", pods=64)))
+        cache.add_queue(build_queue("default"))
+        # long period: a bind inside the window proves the express path
+        s = Scheduler(cache, schedule_period=5.0, express=True)
+        s.run()
+        try:
+            import time
+
+            time.sleep(0.2)  # let the first session drain the empty queue
+            cache.add_pod_group(build_pod_group(
+                "svc", namespace="xp", min_member=1))
+            cache.add_pod(build_pod(
+                "xp", "svc-t0", "", objects.POD_PHASE_PENDING,
+                {"cpu": "250m", "memory": "256Mi"}, "svc"))
+            assert cache.binder.wait_for_binds(1, timeout=3.0), \
+                "express lane did not place within the schedule period"
+            assert s.express_lane.counters["placed"] == 1
+        finally:
+            s.stop()

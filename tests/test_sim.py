@@ -164,6 +164,32 @@ class TestSmokeScenarios:
         assert s["evictions"] > 0
         assert s["binds"] > 0
 
+    def test_serving_mix_express_lane_clean(self):
+        """serving_mix smoke: interactive arrivals ride the express lane
+        between sessions, batch gangs stay with the sessions, and the
+        express_reconciliation invariant (plus all standing rules) holds
+        through flaps/restarts/kills."""
+        cfg = scale_scenario(load_scenario("serving_mix"), 0.5)
+        s = SimCluster(cfg, seed=11).run(duration=60.0)
+        assert s["audit"]["violations"] == 0, s["audit"]
+        ex = s["express"]
+        assert ex is not None
+        # the lane actually placed interactive arrivals...
+        assert ex["placed"] > 0, ex
+        # ...and every optimistic bind got a session verdict
+        assert ex["placed"] == 0 or ex["reconciled"] + ex["reverted"] > 0 \
+            or ex["outstanding"] <= ex["placed"], ex
+        # sessions still own the (express-ineligible) batch gangs
+        assert s["binds"] > ex["placed"], (s["binds"], ex)
+
+    def test_serving_mix_same_seed_identical_hash(self):
+        cfg = scale_scenario(load_scenario("serving_mix"), 0.25)
+        a = SimCluster(cfg, seed=4).run(duration=45.0)
+        b = SimCluster(cfg, seed=4).run(duration=45.0)
+        assert a["event_log_hash"] == b["event_log_hash"]
+        assert a["express"]["placed"] == b["express"]["placed"]
+        assert a["express"]["reverted"] == b["express"]["reverted"]
+
 
 # ---------------------------------------------------------------------------
 # 3. auditor self-test (seeded bug fixtures)
@@ -233,6 +259,15 @@ class TestCfg5Scale:
         assert s["binds"] > 30000, s["binds"]
         assert s["audit"]["violations"] == 0, s["audit"]
         assert s["compiles"]["after_warmup"] == 0, s["compiles"]
+
+    @pytest.mark.slow
+    def test_full_scale_serving_mix(self):
+        cfg = copy.deepcopy(load_scenario("serving_mix"))
+        s = SimCluster(cfg, seed=11, repro_dir=None).run()
+        assert s["audit"]["violations"] == 0, s["audit"]
+        ex = s["express"]
+        assert ex["placed"] > 20, ex
+        assert s["binds"] > ex["placed"]
 
     @pytest.mark.slow
     def test_chaos_soak_two_hours(self):

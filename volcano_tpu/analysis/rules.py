@@ -171,15 +171,22 @@ class BucketShape(Rule):
     id = "VT002"
     title = "unbucketed dynamic shape reaches a jit-static sink"
     patterns = ("*/ops/solver.py", "*/ops/rounds.py", "*/ops/evict.py",
-                "*/ops/session_fuse.py")
+                "*/ops/session_fuse.py",
+                # the express lane dispatches its own jitted round with
+                # bucket-keyed task/job axes and a top_k candidate window
+                "*/express/*.py")
 
     SANITIZERS = {"_bucket"}
-    BLESSED_CALLS = {"pad_encoded"}
+    BLESSED_CALLS = {"pad_encoded",
+                     # express window sink: window_for/task_bucket wrap
+                     # _bucket (express/place.py) — their results are
+                     # ladder values by construction
+                     "window_for", "task_bucket"}
     PAD_FUNCS = {"_pad_axis"}
-    SPEC_CTORS = {"SolveSpec", "EvictSpec"}
+    SPEC_CTORS = {"SolveSpec", "EvictSpec", "ExpressSpec"}
     KERNEL_ENTRIES = {"solve_allocate", "solve_rounds", "solve_rounds_packed",
                       "solve_preempt", "solve_reclaim", "solve_backfill",
-                      "_solve_packed",
+                      "_solve_packed", "solve_express",
                       # fused session stages: their `sizes` tuples are
                       # jit-static exactly like spec fields
                       "_fuse_alloc", "_fuse_backfill", "_fuse_preempt",
@@ -630,7 +637,11 @@ class HotPathDeterminism(Rule):
                 # the sim's replay determinism contract (same seed =>
                 # identical event-log hash) dies the moment any component
                 # iterates an unordered set while making decisions
-                "*/sim/*.py")
+                "*/sim/*.py",
+                # express classification/commit order feeds real binds:
+                # set-order nondeterminism here diverges replicas exactly
+                # like encoder nondeterminism would
+                "*/express/*.py")
 
     _SET_CTORS = {"set", "frozenset"}
     _SET_METHODS = {"union", "intersection", "difference",
@@ -842,7 +853,11 @@ class DonatedBufferReuse(Rule):
     id = "VT006"
     title = "donated buffer reused host-side after dispatch"
     patterns = ("*/ops/session_fuse.py", "*/ops/solver.py",
-                "*/ops/rounds.py", "*/ops/evict.py")
+                "*/ops/rounds.py", "*/ops/evict.py",
+                # express device buffers are long-lived; if a future
+                # revision donates them for in-place patching, the reuse
+                # contract applies identically
+                "*/express/*.py")
 
     @staticmethod
     def _donated_positions(tree: ast.AST) -> Dict[str, tuple]:

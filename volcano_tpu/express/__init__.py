@@ -1,0 +1,25 @@
+"""volcano_tpu/express — event-driven express lane: sub-10 ms incremental
+placement for interactive arrivals between full sessions, reconciled by
+the next full session (the fairness/preemption authority).
+
+Modules:
+- trigger.py   — watch-triggered arrival queue + eligibility envelope +
+                 the run-once fast path (ExpressLane);
+- encode.py    — dirty-row live node axis + device buffer cache;
+- place.py     — the one-dispatch narrow windowed round (jax);
+- commit.py    — optimistic validate-then-commit via the real cache
+                 effectors;
+- reconcile.py — full-session confirm/revert of every optimistic bind.
+
+Only place.py (and ExpressState.stage) require jax; everything else runs
+on a jax-free host, where the lane simply defers every arrival.
+"""
+
+from volcano_tpu.express.trigger import (  # noqa: F401
+    EXPRESS_MAX_GANG,
+    EXPRESS_MAX_TASKS,
+    EXPRESS_SAFE_PLUGINS,
+    ExpressLane,
+    ExpressToken,
+)
+from volcano_tpu.express.reconcile import reconcile_session  # noqa: F401

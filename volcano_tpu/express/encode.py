@@ -1,0 +1,212 @@
+"""Express live state — dirty-row maintenance of the node axis between
+sessions, plus the device-resident buffer cache the express kernel solves
+against.
+
+The SnapshotKeeper's axis belongs to the SESSION snapshot and is only
+reconciled at ``snapshot()`` time. The express lane places between
+sessions, from the CACHE's live truth, so it maintains its own columnar
+axis over the live NodeInfo objects and keeps the derived solve buffers
+resident on device:
+
+- a dirty-set **shadow** registered with the SnapshotKeeper
+  (snapkeeper.add_shadow) receives every mark the keeper receives —
+  watch handlers, bind/evict effectors, bulk-apply syncs — without
+  consuming the keeper's own sets;
+- ``refresh()`` (caller holds the cache lock) drains the shadow: marked
+  rows are patched in place via the shared ``nodeaxis.refresh_rows``, an
+  accounting-generation sweep catches in-place mutations that have no
+  mark (the deferred mirror flush), and membership changes fall back to a
+  full recapture — exactly the keeper's own honesty ladder;
+- ``stage()`` ships ONLY the patched rows to the device: a bucketed
+  index + row-value scatter through a tiny jitted patch kernel, so the
+  per-arrival h2d budget is O(rows the cluster actually changed), not
+  O(nodes). A full rebuild (first use, membership change, generation
+  bump) re-puts the axis wholesale and is counted separately.
+
+Nothing here requires jax until ``stage()`` runs; a jax-free host can
+still construct the state (the lane then defers everything).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from volcano_tpu.scheduler.cache.nodeaxis import (
+    F_BLOCKING_TAINTS,
+    F_NET_UNAVAILABLE,
+    F_READY,
+    F_UNSCHEDULABLE,
+    capture_node_axis,
+    refresh_rows,
+)
+
+# flags a node must / must not carry to take express placements — the
+# static half of the default predicate chain (encoder._static_node_ok
+# with the pressure checks at their default-off conf)
+_BAD_FLAGS = int(F_NET_UNAVAILABLE) | int(F_UNSCHEDULABLE) \
+    | int(F_BLOCKING_TAINTS)
+
+
+def _ok_col(flags: np.ndarray) -> np.ndarray:
+    return ((flags & F_READY) != 0) & ((flags & np.uint16(_BAD_FLAGS)) == 0)
+
+
+class ExpressState:
+    """Live node axis + device buffer cache for one SchedulerCache."""
+
+    # dirty-row budget: past this fraction of the axis a wholesale re-put
+    # is cheaper than the scatter (and the patch bucket ladder stops
+    # paying for itself)
+    PATCH_FRACTION = 4
+
+    def __init__(self, cache):
+        self.cache = cache
+        self.shadow = cache.snap_keeper.add_shadow()
+        self.axis = None
+        self._index: Dict[str, int] = {}
+        self._seen_generation = -1
+        self.dev: Optional[dict] = None
+        self.n = 0
+        self.stats = {"rebuilds": 0, "row_patches": 0, "patched_rows": 0,
+                      "h2d_puts": 0}
+
+    def detach(self) -> None:
+        self.cache.snap_keeper.drop_shadow(self.shadow)
+
+    # -- host refresh (caller holds the cache lock) ------------------------
+
+    def _rebuild(self) -> None:
+        ready = {name: nd for name, nd in self.cache.nodes.items()
+                 if nd.ready()}
+        self.axis = capture_node_axis(ready)
+        self._index = {name: i for i, name in enumerate(self.axis.names)}
+        self._seen_generation = self.shadow.generation
+        self.shadow.dirty_nodes.clear()
+        self.n = len(self.axis.names)
+        self.dev = None  # stage() re-puts wholesale
+        self.stats["rebuilds"] += 1
+
+    def refresh(self) -> list:
+        """Reconcile the axis with the live cache; returns the patched row
+        indices (empty after a wholesale rebuild — ``self.dev is None``
+        then signals stage() to re-put)."""
+        axis = self.axis
+        if axis is None or self._seen_generation != self.shadow.generation:
+            self._rebuild()
+            return []
+
+        dirty = self.shadow.dirty_nodes
+        self.shadow.dirty_nodes = set()
+        updates: Dict[int, object] = {}
+        index = self._index
+        for name in sorted(dirty):
+            nd = self.cache.nodes.get(name)
+            ready = nd is not None and nd.ready()
+            if ready != (name in index):
+                self._rebuild()  # membership changed
+                return []
+            if ready:
+                updates[index[name]] = nd
+        # unmarked in-place churn: the deferred mirror flush mutates cache
+        # twins without a dirty mark; every such mutation bumps _acct_gen,
+        # so a generation sweep over the shared live objects catches it
+        n = len(axis.nodes)
+        if n:
+            cur = np.fromiter((nd._acct_gen for nd in axis.nodes),
+                              np.int64, n)
+            for i in np.nonzero(cur != axis.gens)[0].tolist():
+                updates.setdefault(i, axis.nodes[i])
+        if not updates:
+            return []
+        rows = sorted(updates.items())
+        if not refresh_rows(axis, rows):
+            self._rebuild()  # new scalar dimension reshapes columns
+            return []
+        # a row whose readiness flag flipped without an add/delete mark
+        # (e.g. an OutOfSync trip) changes the ok column, which the patch
+        # path carries — no special case needed
+        self.stats["row_patches"] += 1
+        self.stats["patched_rows"] += len(rows)
+        if self.dev is not None and len(rows) * self.PATCH_FRACTION > self.n:
+            self.dev = None  # wholesale re-put beats a huge scatter
+        return [i for i, _ in rows]
+
+    # -- host columns ------------------------------------------------------
+
+    def _host_cols(self, rows=None):
+        """(idle, alloc, cnt, ok, maxt) as dense arrays — full axis, or
+        gathered for the given row indices."""
+        axis = self.axis
+        if rows is None:
+            sel = slice(None)
+        else:
+            sel = np.asarray(rows, np.int32)
+        idle = np.stack([axis.cpu["idle"][sel], axis.mem["idle"][sel]],
+                        axis=1)
+        alloc = np.stack([axis.cpu["alloc"][sel], axis.mem["alloc"][sel]],
+                         axis=1)
+        cnt = axis.node_cnt[sel].astype(np.int32)
+        ok = _ok_col(axis.flags[sel])
+        maxt = axis.max_tasks[sel].astype(np.int32)
+        return idle, alloc, cnt, ok, maxt
+
+    # -- device staging ----------------------------------------------------
+
+    def stage(self, rows: list) -> dict:
+        """Device twins of the axis columns: wholesale put on rebuild,
+        dirty-row scatter otherwise. Returns the device buffer dict."""
+        import jax
+
+        from volcano_tpu.ops.solver import _bucket
+
+        if self.dev is None:
+            idle, alloc, cnt, ok, maxt = self._host_cols()
+            self.dev = {
+                "idle": jax.device_put(idle),
+                "alloc": jax.device_put(alloc),
+                "cnt": jax.device_put(cnt),
+                "ok": jax.device_put(ok),
+                "maxt": jax.device_put(maxt),
+            }
+            self.stats["h2d_puts"] += len(self.dev)
+            return self.dev
+        if rows:
+            db = _bucket(max(len(rows), 1))
+            # padding repeats the first dirty row — duplicate scatter
+            # writes of identical values, benign exactly as in
+            # rounds._rescore_dirty
+            padded = [rows[0]] * (db - len(rows)) + list(rows)
+            idx = np.asarray(padded, np.int32)
+            idle, alloc, cnt, ok, maxt = self._host_cols(padded)
+            self.dev = dict(zip(
+                ("idle", "alloc", "cnt", "ok", "maxt"),
+                _patch_rows(self.dev["idle"], self.dev["alloc"],
+                            self.dev["cnt"], self.dev["ok"],
+                            self.dev["maxt"], idx,
+                            idle, alloc, cnt, ok, maxt)))
+            self.stats["h2d_puts"] += 6  # idx + five row blocks
+        return self.dev
+
+
+def _patch_rows(idle, alloc, cnt, ok, maxt, idx,
+                idle_r, alloc_r, cnt_r, ok_r, maxt_r):
+    """Scatter dirty rows into the device-resident columns. Jitted lazily
+    (import-time jax dependence would break jax-free hosts)."""
+    global _patch_rows_jit
+    if _patch_rows_jit is None:
+        import jax
+
+        def patch(idle, alloc, cnt, ok, maxt, idx,
+                  idle_r, alloc_r, cnt_r, ok_r, maxt_r):
+            return (idle.at[idx].set(idle_r), alloc.at[idx].set(alloc_r),
+                    cnt.at[idx].set(cnt_r), ok.at[idx].set(ok_r),
+                    maxt.at[idx].set(maxt_r))
+
+        _patch_rows_jit = jax.jit(patch)
+    return _patch_rows_jit(idle, alloc, cnt, ok, maxt, idx,
+                           idle_r, alloc_r, cnt_r, ok_r, maxt_r)
+
+
+_patch_rows_jit = None

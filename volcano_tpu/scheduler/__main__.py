@@ -43,6 +43,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--schedule-period", type=float,
                     default=d.schedule_period_seconds, metavar="SECONDS")
     ap.add_argument("--default-queue", default=d.default_queue)
+    ap.add_argument("--express", action="store_true", default=False,
+                    help="enable the event-driven express lane: eligible "
+                         "interactive arrivals place between periodic "
+                         "sessions (volcano_tpu/express)")
     ap.add_argument("--leader-elect", action="store_true", default=False)
     ap.add_argument("--lock-object-namespace", default="volcano-system")
     ap.add_argument("--leader-elect-identity", default="",
@@ -212,7 +216,8 @@ def run_remote_scheduler(args) -> int:
         default_queue=args.default_queue)
     cache.run()
     scheduler = Scheduler(
-        cache, scheduler_conf="", schedule_period=args.schedule_period)
+        cache, scheduler_conf="", schedule_period=args.schedule_period,
+        express=args.express)
     if args.scheduler_conf:
         scheduler.conf_path = args.scheduler_conf
 

@@ -302,6 +302,17 @@ class SchedulerCache:
         # mirror being one flush behind inside a cycle is the faithful
         # semantic (cache.go:123-135,597-613)
         self._pending_mirrors: List[dict] = []
+        # express lane (volcano_tpu/express): the lane registers itself
+        # plus an arrival listener; the listener runs under the cache lock
+        # from the watch handlers and must only enqueue
+        self.express_lane = None
+        self._arrival_listener = None
+
+    def set_arrival_listener(self, fn) -> None:
+        """Register the express lane's arrival callback: fn(job_uid) is
+        invoked (under the cache lock) whenever a schedulable pending task
+        or a PodGroup lands — mirror + enqueue only, by contract."""
+        self._arrival_listener = fn
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -366,6 +377,9 @@ class SchedulerCache:
                 self.nodes[ti.node_name] = NodeInfo(None)
             if not _is_terminated(ti.status):
                 self.nodes[ti.node_name].add_task(ti)
+        elif ti.status == TaskStatus.PENDING and ti.job \
+                and self._arrival_listener is not None:
+            self._arrival_listener(ti.job)
 
     def _delete_task(self, ti: TaskInfo) -> None:
         self.snap_keeper.mark_job(ti.job)
@@ -469,6 +483,10 @@ class SchedulerCache:
             job.set_pod_group(pg)
             if not job.queue:
                 job.queue = self.default_queue
+            if self._arrival_listener is not None:
+                # a group admitted after its pods arrived completes the
+                # express eligibility picture — re-nudge the lane
+                self._arrival_listener(job_id)
 
     def update_pod_group_from_watch(self, old: objects.PodGroup, new: objects.PodGroup) -> None:
         self.add_pod_group(new)

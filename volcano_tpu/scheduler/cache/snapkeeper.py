@@ -62,6 +62,21 @@ from volcano_tpu.scheduler.cache.nodeaxis import (
 )
 
 
+class DirtyShadow:
+    """A second consumer of the keeper's dirty marks (the express lane's
+    live-axis maintenance, express/encode.py): every mark_job/mark_node
+    lands in each registered shadow too, so a between-sessions consumer
+    can drain its own copy without racing ``snapshot()`` for the keeper's
+    sets. ``generation`` mirrors the keeper's wholesale-rebuild signal."""
+
+    __slots__ = ("dirty_jobs", "dirty_nodes", "generation")
+
+    def __init__(self):
+        self.dirty_jobs: Set[str] = set()
+        self.dirty_nodes: Set[str] = set()
+        self.generation = 0
+
+
 class SnapshotKeeper:
     def __init__(self):
         self.enabled = not os.environ.get("VOLCANO_TPU_WHOLESALE_SNAPSHOT")
@@ -71,6 +86,7 @@ class SnapshotKeeper:
         self.node_gens: Dict[str, int] = {}  # name -> in-sync _acct_gen
         self.dirty_jobs: Set[str] = set()
         self.dirty_nodes: Set[str] = set()
+        self.shadows: list = []   # DirtyShadow fan-out (express lane)
         self.generation = 0       # bump => next snapshot fully rebuilds
         self._built_generation = -1
         self.axis = None
@@ -82,13 +98,30 @@ class SnapshotKeeper:
 
     # -- marks (called under the cache lock) --------------------------------
 
+    def add_shadow(self) -> DirtyShadow:
+        """Register an express-lane dirty-set shadow; it receives every
+        subsequent mark. Start dirty via generation so the first consumer
+        refresh is a wholesale rebuild."""
+        sh = DirtyShadow()
+        sh.generation = -1
+        self.shadows.append(sh)
+        return sh
+
+    def drop_shadow(self, sh: DirtyShadow) -> None:
+        if sh in self.shadows:
+            self.shadows.remove(sh)
+
     def mark_job(self, uid: str) -> None:
         if uid:
             self.dirty_jobs.add(uid)
+            for sh in self.shadows:
+                sh.dirty_jobs.add(uid)
 
     def mark_node(self, name: str) -> None:
         if name:
             self.dirty_nodes.add(name)
+            for sh in self.shadows:
+                sh.dirty_nodes.add(name)
 
     def mark_evict(self, job_uid: str, node_name: str) -> None:
         """Eviction effector path: dirty both sides of the eviction in one
@@ -101,6 +134,8 @@ class SnapshotKeeper:
 
     def invalidate(self) -> None:
         self.generation += 1
+        for sh in self.shadows:
+            sh.generation += 1
 
     # -- bulk-flush sync ----------------------------------------------------
 

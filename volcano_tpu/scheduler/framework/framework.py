@@ -54,6 +54,14 @@ def run_actions(ssn: Session, actions) -> dict:
     from volcano_tpu.scheduler.framework.plugins import get_action
 
     names = [a if isinstance(a, str) else a.name() for a in actions]
+    if getattr(ssn.cache, "express_lane", None) is not None:
+        # reconcile every outstanding express bind FIRST: the session is
+        # the fairness/preemption authority, and reverts must free their
+        # capacity before this session's own placement decisions encode
+        from volcano_tpu.express.reconcile import reconcile_session
+
+        ssn.cache.express_lane.set_tiers(ssn.tiers)
+        reconcile_session(ssn)
     try:
         from volcano_tpu.ops import session_fuse
     except Exception:  # pragma: no cover - jax-free host

@@ -116,6 +116,23 @@ class Registry:
             f"{_NAMESPACE}_unschedule_job_count", "Number of unschedulable jobs")
         self.job_retry_counts = Counter(
             f"{_NAMESPACE}_job_retry_counts", "Job retries", ("job_id",))
+        # express lane (volcano_tpu/express): optimistic placements
+        # between sessions, the session-time reverts, and the fast-path
+        # latency distribution (sub-10 ms is the design envelope, so the
+        # buckets resolve single milliseconds)
+        self.express_placements = Counter(
+            f"{_NAMESPACE}_express_placements_total",
+            "Tasks optimistically placed by the express lane")
+        self.express_reverted = Counter(
+            f"{_NAMESPACE}_express_reverted_total",
+            "Express placements reverted by full-session reconciliation")
+        self.express_deferred = Counter(
+            f"{_NAMESPACE}_express_deferred_total",
+            "Arrivals the express lane deferred to a full session")
+        self.express_latency = Histogram(
+            f"{_NAMESPACE}_express_latency_seconds",
+            "Express run-once latency in seconds",
+            [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25])
         # instantaneous cluster levels (set each cycle; the sim harness and
         # the scheduler loop both publish through these)
         self.pending_pods = Gauge(
@@ -207,6 +224,22 @@ def set_sessions_run(n: int) -> None:
     registry().sessions_run.set(n)
 
 
+def register_express_placements(n: int = 1) -> None:
+    registry().express_placements.inc(value=n)
+
+
+def register_express_reverted(n: int = 1) -> None:
+    registry().express_reverted.inc(value=n)
+
+
+def register_express_deferred(n: int = 1) -> None:
+    registry().express_deferred.inc(value=n)
+
+
+def observe_express_latency(seconds: float) -> None:
+    registry().express_latency.observe(seconds)
+
+
 # -- exposition -------------------------------------------------------------
 
 
@@ -214,7 +247,8 @@ def render() -> str:
     """Prometheus text format for the /metrics endpoint analog."""
     r = registry()
     lines: List[str] = []
-    for h in (r.e2e_latency, r.plugin_latency, r.action_latency, r.task_latency):
+    for h in (r.e2e_latency, r.plugin_latency, r.action_latency,
+              r.task_latency, r.express_latency):
         lines.append(f"# HELP {h.name} {h.help}")
         lines.append(f"# TYPE {h.name} histogram")
         for labels, (counts, total, n) in h.snapshot().items():
@@ -233,6 +267,7 @@ def render() -> str:
     for c in (
         r.schedule_attempts, r.preemption_victims, r.preemption_attempts,
         r.unschedule_task_count, r.unschedule_job_count, r.job_retry_counts,
+        r.express_placements, r.express_reverted, r.express_deferred,
     ):
         lines.append(f"# HELP {c.name} {c.help}")
         lines.append(f"# TYPE {c.name} counter")

@@ -23,7 +23,16 @@ the metrics registry against each other:
                           performed;
 - ``fair_share``        — optional bounded-drift check between weighted
                           queues (only meaningful under reclaim-enabled
-                          scenarios; off by default).
+                          scenarios; off by default);
+- ``express_reconciliation`` — every optimistic express bind is resolved
+                          (confirmed or reverted) by the next full
+                          session: no token may outlive a session, and a
+                          reverted bind leaves zero residue on its node's
+                          task map. The gang/quota/overcommit half of the
+                          express contract is enforced by the standing
+                          rules above running in the same audit pass —
+                          express placements go through the same store/
+                          cache state they check.
 
 A violation dumps a minimized repro bundle (scenario + seed + virtual
 time + offending objects + the event-log tail) under the run's repro
@@ -91,6 +100,7 @@ class Auditor:
         found.extend(self._check_phantom_cache())
         found.extend(self._check_mirrors())
         found.extend(self._check_event_consistency())
+        found.extend(self._check_express())
         if self.cfg.get("fair_share"):
             found.extend(self._check_fair_share())
         self.checks_run += 1
@@ -268,6 +278,37 @@ class Auditor:
                 "event_consistency", "preemption-victims",
                 f"preemption-victim metric is negative: {victims}",
                 {"metric_victims": victims}))
+        return out
+
+    def _check_express(self) -> List[Violation]:
+        """Express-reconciliation invariant: every optimistic bind is
+        confirmed or cleanly reclaimed within one full session."""
+        out: List[Violation] = []
+        lane = getattr(self.sim, "express_lane", None)
+        if lane is None:
+            return out
+        # a token recorded before the most recent session must be gone:
+        # the session-time reconciler resolves every outstanding token,
+        # so anything older than the current seq slipped through
+        stale = sorted(uid for uid, tok in lane.outstanding.items()
+                       if tok.seq < lane.session_seq)
+        if stale:
+            out.append(Violation(
+                "express_reconciliation", "unresolved-tokens",
+                f"{len(stale)} express tokens outlived a full session "
+                f"without a confirm/revert verdict",
+                {"jobs": stale[:20]}))
+        # reverted binds leave zero residue: the eviction flowed through
+        # the real effectors, so by audit time (post-slice convergence)
+        # the node task map no longer holds the reverted task
+        cache = self.sim.cache
+        for job_uid, task_key, node_name in lane.last_reverts:
+            node = cache.nodes.get(node_name)
+            if node is not None and task_key in node.tasks:
+                out.append(Violation(
+                    "express_reconciliation", task_key,
+                    f"reverted express bind still resident on {node_name}",
+                    {"job": job_uid, "node": node_name}))
         return out
 
     def _check_fair_share(self) -> List[Violation]:

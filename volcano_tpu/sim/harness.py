@@ -119,6 +119,8 @@ class SimCluster:
         # submit->bind latency per pod, virtual seconds (storm headline);
         # created before the scheduler build, which hands it to the binder
         self._task_wait_s: List[float] = []
+        self.express_lane = None
+        self._express_ms: List[float] = []
         self._build_controllers()
         self._build_scheduler()
         self.mirrors = [
@@ -167,6 +169,17 @@ class SimCluster:
             evictor=_CountingEvictor(self.store, self.counters))
         self.cache.run()
         self.cache.wait_for_cache_sync()
+        if (self.cfg.get("express") or {}).get("enabled"):
+            # one lane for the sim's lifetime, re-attached across
+            # scheduler restarts: tokens survive a crash (the binds are
+            # durable in the store) and the next session still owes them
+            # a reconciliation verdict
+            from volcano_tpu.express import ExpressLane
+
+            if self.express_lane is None:
+                self.express_lane = ExpressLane(self.cache)
+            else:
+                self.express_lane.attach(self.cache)
 
     def restart_scheduler(self, why: str) -> None:
         """Crash-recover the scheduler: drop the cache (incl. any deferred
@@ -285,6 +298,27 @@ class SimCluster:
         if at <= self._horizon + 1e-9:
             self.engine.schedule_at(at, "session", self._session_slice)
 
+    # -- the express slice -------------------------------------------------
+
+    def _express_slice(self) -> str:
+        """One express micro-slice between sessions: run the controllers
+        (pods materialize through the production submit path, exactly as
+        the continuously-running controllers would have), then drain the
+        lane's arrival queue. The logged line carries only deterministic
+        counts — wall latency goes to the summary, never the hashed log."""
+        self._controllers_step()
+        t0 = time.perf_counter()
+        rep = self.express_lane.run_once()
+        self._express_ms.append((time.perf_counter() - t0) * 1e3)
+        self._schedule_express()
+        return (f"queued={rep['queued']} placed={rep['placed']} "
+                f"deferred={rep['deferred']}")
+
+    def _schedule_express(self) -> None:
+        at = self.vclock.now() + float(self.cfg["express"]["period_s"])
+        if at <= self._horizon + 1e-9:
+            self.engine.schedule_at(at, "express", self._express_slice)
+
     # -- run ---------------------------------------------------------------
 
     def run(self, duration: Optional[float] = None) -> Dict:
@@ -313,6 +347,8 @@ class SimCluster:
             self.workload.start()
             self.chaos.start()
             self._schedule_slice()
+            if self.express_lane is not None:
+                self._schedule_express()
             self.engine.run_until(self._horizon)
             self.engine.log_event(
                 "end",
@@ -375,4 +411,12 @@ class SimCluster:
             "event_log_hash": self.engine.log_hash(),
             "log_records": self.engine.log_records,
             "events_run": self.engine.events_run,
+            "express": ({
+                **{k: v for k, v in
+                   self.express_lane.counters.items()},
+                "outstanding": len(self.express_lane.outstanding),
+                "slice_ms": _percentiles(self._express_ms),
+                "state": dict(self.express_lane.state.stats)
+                if self.express_lane.state else {},
+            } if self.express_lane is not None else None),
         }

@@ -74,8 +74,19 @@ DEFAULTS: Dict = {
         "max_jobs": None,
         "ttl_s": None,
         "trace": None,        # path (relative to the scenario file)
+        # interactive sub-population (serving/inference pods riding along
+        # the batch gangs — the express lane's workload class): when set,
+        # each sampled job flips to the interactive shape with `prob`.
+        # None keeps the sampling draw-order of every existing scenario
+        # byte-identical.
+        "interactive": None,
     },
     "mirrors": {"kinds": ["Pod", "Node", "PodGroup"], "cap": 512},
+    # express lane (volcano_tpu/express): event-driven placement slices
+    # between sessions; period_s paces the micro-slices that drain the
+    # arrival queue (production is wake-event-driven; the sim quantizes
+    # to engine events for determinism)
+    "express": {"enabled": False, "period_s": 0.25},
     "faults": {},
     "audit": {
         "every_sessions": 1,
@@ -200,7 +211,26 @@ def sample_job_shape(cfg: Dict, rng) -> Dict:
         "fail": rng.random() < wl["fail_prob"],
         "cancel": rng.random() < wl["cancel_prob"],
         "resubmit": rng.random() < wl["resubmit_prob"],
+        "interactive": False,
     }
+    inter = wl.get("interactive")
+    if inter:
+        # extra draws happen ONLY when the scenario opts in, so existing
+        # scenarios keep their exact workload streams (hash stability)
+        if rng.random() < float(inter.get("prob", 0.5)):
+            lo, hi = inter.get("service_s", wl["service_s"])
+            shape.update(
+                tasks=int(inter.get("tasks", 1)),
+                min_member=int(inter.get("min_member", 1)),
+                cpu=rng.choice(list(inter.get(
+                    "cpu_choices", wl["cpu_choices"]))),
+                mem=rng.choice(list(inter.get(
+                    "mem_choices", wl["mem_choices"]))),
+                service_s=rng.uniform(float(lo), float(hi)),
+                interactive=True,
+            )
+            if inter.get("queue"):
+                shape["queue"] = str(inter["queue"])
     return shape
 
 

@@ -38,3 +38,24 @@ class GoodCache:
     def legacy_sync(self):
         with self._lock:
             self.store.delete("Pod", "ns", "p")  # vclint: disable=VT003 - single-threaded bootstrap, store has no watchers yet
+
+
+class GoodElector:
+    """HA scope, discipline followed: the lease write happens after the
+    record lock is released; the breaker gate never calls back into a
+    self-lock-acquiring method while held."""
+
+    def __init__(self, store):
+        self.store = store
+        self._record_lock = threading.Lock()
+        self._record = None
+
+    def renew(self, record):
+        with self._record_lock:
+            stale = self._record
+        self.store.update(record)  # write AFTER release
+        return stale
+
+    def allow(self):
+        with self._record_lock:
+            return self._record is not None

@@ -27,3 +27,26 @@ class BadCache:
         # watch handlers run under the STORE lock: a synchronous write
         # re-enters dispatch
         self.store.update_status(job)  # vclint-expect: VT003
+
+
+class BadElector:
+    """HA scope: the lease record lock sits UNDER the store lock in the
+    callback graph — renewing (a store write) while holding it inverts
+    the order exactly like a cache writeback would."""
+
+    def __init__(self, store):
+        self.store = store
+        self._record_lock = threading.Lock()
+        self._record = None
+
+    def renew(self, record):
+        with self._record_lock:
+            self.store.update(record)  # vclint-expect: VT003
+
+    def observe(self):
+        with self._record_lock:
+            self._refresh()  # vclint-expect: VT003
+
+    def _refresh(self):
+        with self._record_lock:
+            self._record = self.store

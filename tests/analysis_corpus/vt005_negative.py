@@ -41,3 +41,13 @@ def sim_fire_faults(engine, down_nodes, flip):
         engine.schedule(name)
     pending = {j for j in flip}
     return [audit(j) for j in sorted(pending)]
+
+
+def takeover_drain(tokens, rungs):
+    # HA scope: sorted() pins the drain order — active and standby replay
+    # the takeover identically under the same seed
+    undrained = {t.uid for t in tokens}
+    for uid in sorted(undrained):
+        drain(uid)
+    active = {r for r in rungs}
+    return [publish(r) for r in sorted(active)]

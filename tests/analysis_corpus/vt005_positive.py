@@ -36,3 +36,14 @@ def sim_fire_faults(engine, flap_names, flip):
         engine.schedule(name)
     pending = {j for j in flip}
     return [audit(j) for j in pending]  # vclint-expect: VT005
+
+
+def takeover_drain(tokens, rungs):
+    # HA scope: the new leader's first session drains standby-era express
+    # tokens — set iteration here reorders the revert/confirm event log
+    # and forks the same-seed hash between active and standby
+    undrained = {t.uid for t in tokens}
+    for uid in undrained:  # vclint-expect: VT005
+        drain(uid)
+    active = {r for r in rungs}
+    return [publish(r) for r in active]  # vclint-expect: VT005

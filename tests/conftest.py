@@ -22,3 +22,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_degrade_ladder():
+    """The process-default degradation ladder (scheduler/degrade.py) is
+    deliberately global — a test that trips its breakers must not leak a
+    degraded rung into later tests' solve paths."""
+    from volcano_tpu.scheduler import degrade
+
+    degrade.reset()
+    yield
+    degrade.reset()

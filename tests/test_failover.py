@@ -1,0 +1,626 @@
+"""Fenced active/standby failover + the fault-degradation ladder.
+
+Four layers (docs/DESIGN.md §15):
+
+1. lease-epoch fencing at the store: stale-epoch writes rejected with
+   exact accounting, lease writes advance the fence atomically, the
+   FencedStoreView facade, and the HTTP hop (ApiGateway + RemoteStore)
+   preserving the FencedError subtype end-to-end;
+2. the two-elector race: over one store, exactly one epoch's binds land
+   — before AND after a leadership transition;
+3. warm standby + FailoverScheduler: a non-leading member keeps its
+   snapshot warm and takes over binding authority with the fence
+   stamped before its first session;
+4. the degradation ladder: deterministic capped/jittered backoff,
+   per-dependency circuit breakers on the virtual clock, the bounded
+   session-skip budget, and the scheduler loop actually honoring it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from volcano_tpu.api import objects
+from volcano_tpu.scheduler import degrade, metrics
+from volcano_tpu.scheduler.cache import SchedulerCache
+from volcano_tpu.scheduler.cache.cache import (
+    DefaultBinder,
+    DefaultEvictor,
+    DefaultStatusUpdater,
+)
+from volcano_tpu.scheduler.ha import FailoverScheduler, WarmStandby
+from volcano_tpu.scheduler.leaderelection import (
+    LeaderElectionRecord,
+    LeaderElector,
+    ResourceLock,
+)
+from volcano_tpu.scheduler.scheduler import Scheduler
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list_with_pods,
+)
+from volcano_tpu.store import FencedError, FencedStoreView, Store
+from volcano_tpu.store.gateway import ApiGateway
+from volcano_tpu.store.remote import RemoteStore
+from volcano_tpu.utils import clock
+
+FAST = dict(lease_duration=0.5, renew_deadline=0.3, retry_period=0.1)
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def _lease(store, transitions=0, holder="h1"):
+    """Write a lease record through the real resource-lock path; the
+    store's fence advances to transitions + 1 in the same atomic step."""
+    lock = ResourceLock(store, "volcano-system", "vc-scheduler", holder)
+    got = lock.get()
+    now = time.monotonic()
+    new = LeaderElectionRecord(
+        holder_identity=holder, lease_duration=30.0,
+        acquire_time=now, renew_time=now, leader_transitions=transitions)
+    if got is None:
+        assert lock.create(new)
+    else:
+        assert lock.update(new, got[1])
+
+
+# ---------------------------------------------------------------------------
+# 1. store-level fencing
+# ---------------------------------------------------------------------------
+
+
+class TestStoreFencing:
+    def test_unstamped_writes_always_pass(self):
+        store = Store()
+        _lease(store)  # fence armed at epoch 1
+        pod = build_pod("ns", "p", "", objects.POD_PHASE_PENDING,
+                        {"cpu": "1"}, "")
+        store.create(pod)         # controllers/kubelets carry no stamp
+        store.update(pod)
+        store.delete("Pod", "ns", "p")
+        assert store.fence_stats["rejected"] == 0
+
+    def test_stale_epoch_rejected_with_accounting(self):
+        store = Store()
+        pod = build_pod("ns", "p", "", objects.POD_PHASE_PENDING,
+                        {"cpu": "1"}, "")
+        store.create(pod, epoch=0)  # no lease yet: 0 >= fence 0 passes
+        _lease(store)               # epoch 1
+        assert store.fence_epoch == 1
+        with pytest.raises(FencedError):
+            store.update(pod, epoch=0)
+        with pytest.raises(FencedError):
+            store.update_status(pod, epoch=0)
+        with pytest.raises(FencedError):
+            store.delete("Pod", "ns", "p", epoch=0)
+        store.update(pod, epoch=1)  # the current term still writes
+        stats = store.fence_stats
+        assert stats["rejected"] == 3
+        assert stats["rejected_by_kind"] == {"Pod": 3}
+        assert stats["rejected_by_epoch"] == {0: 3}
+
+    def test_fenced_error_is_a_conflict(self):
+        # every pre-existing 409/conflict handler must keep working
+        from volcano_tpu.store import ConflictError
+
+        assert issubclass(FencedError, ConflictError)
+
+    def test_lease_transition_advances_fence_never_lowers(self):
+        store = Store()
+        _lease(store, transitions=0)
+        assert store.fence_epoch == 1
+        _lease(store, transitions=4, holder="h2")  # takeover
+        assert store.fence_epoch == 5
+        _lease(store, transitions=1, holder="h3")  # replayed old lease
+        assert store.fence_epoch == 5, "fence must be monotonic"
+        store.advance_fence(3)
+        assert store.fence_epoch == 5
+        store.advance_fence(9)
+        assert store.fence_epoch == 9
+
+    def test_clean_release_keeps_fence(self):
+        store = Store()
+        _lease(store, transitions=2)
+        assert store.fence_epoch == 3
+        # a released lease (empty holder) keeps the current epoch in
+        # force: un-led intervals must not reopen the old term's window
+        _lease(store, transitions=2, holder="")
+        assert store.fence_epoch == 3
+
+    def test_fenced_store_view_stamps_every_mutator(self):
+        store = Store()
+        epoch = {"v": 1}
+        view = FencedStoreView(store, lambda: epoch["v"])
+        pod = build_pod("ns", "p", "", objects.POD_PHASE_PENDING,
+                        {"cpu": "1"}, "")
+        view.create(pod)
+        _lease(store, transitions=4)  # fence jumps to 5
+        with pytest.raises(FencedError):
+            view.update(pod)
+        with pytest.raises(FencedError):
+            view.update_status(pod)
+        with pytest.raises(FencedError):
+            view.delete("Pod", "ns", "p")
+        epoch["v"] = 5  # the view re-reads the source at call time
+        view.update(pod)
+        # reads pass through unchanged
+        assert view.get("Pod", "ns", "p") is not None
+        assert view.try_delete("Pod", "ns", "missing") is None
+
+    def test_effectors_count_fenced_rejections(self):
+        store = Store()
+        pod = build_pod("ns", "p", "", objects.POD_PHASE_PENDING,
+                        {"cpu": "1"}, "")
+        store.create(pod)
+        _lease(store, transitions=1)  # fence 2
+        binder = DefaultBinder(store)
+        evictor = DefaultEvictor(store)
+        updater = DefaultStatusUpdater(store)
+        for eff in (binder, evictor, updater):
+            eff.fence_epoch = 1  # the deposed term's stamp
+        with pytest.raises(FencedError):
+            binder.bind(pod, "n1")
+        with pytest.raises(FencedError):
+            evictor.evict(pod, "test")
+        cond = objects.PodCondition(
+            type="PodScheduled", status="False", reason="x", message="")
+        updater.update_pod_condition(pod, cond)  # swallowed, counted
+        assert binder.fenced_rejections == 1
+        assert evictor.fenced_rejections == 1
+        assert updater.fenced_rejections == 1
+        assert store.fence_stats["rejected"] == 3
+
+    def test_metrics_counter_tracks_rejections(self):
+        metrics.reset()
+        store = Store()
+        pod = build_pod("ns", "p", "", objects.POD_PHASE_PENDING,
+                        {"cpu": "1"}, "")
+        store.create(pod)
+        _lease(store)
+        with pytest.raises(FencedError):
+            store.update(pod, epoch=0)
+        assert metrics.registry().fenced_writes_rejected.get() == 1
+
+
+# ---------------------------------------------------------------------------
+# 1b. fencing across the HTTP hop
+# ---------------------------------------------------------------------------
+
+
+class TestGatewayFencing:
+    def test_epoch_stamp_enforced_and_subtype_survives(self):
+        store = Store()
+        gateway = ApiGateway(store).start()
+        try:
+            remote = RemoteStore(f"127.0.0.1:{gateway.port}")
+            # a REMOTE elector arms the fence through the gateway: the
+            # lease CAS and the write-authority revocation are one step
+            _lease(remote, transitions=0, holder="remote-a")
+            assert store.fence_epoch == 1
+            pod = build_pod("ns", "p", "", objects.POD_PHASE_PENDING,
+                            {"cpu": "1"}, "")
+            remote.create(pod)  # unstamped: fine
+            pod = remote.get("Pod", "ns", "p")
+            pod.spec.node_name = "n1"
+            with pytest.raises(FencedError):
+                remote.update(pod, epoch=0)
+            remote.update(pod, epoch=1)
+            with pytest.raises(FencedError):
+                remote.delete("Pod", "ns", "p", epoch=0)
+            with pytest.raises(FencedError):
+                remote.create(build_pod(
+                    "ns", "p2", "", objects.POD_PHASE_PENDING,
+                    {"cpu": "1"}, ""), epoch=0)
+            remote.delete("Pod", "ns", "p", epoch=1)
+            assert store.fence_stats["rejected"] == 3
+        finally:
+            gateway.stop()
+
+    def test_malformed_epoch_is_a_400(self):
+        store = Store()
+        gateway = ApiGateway(store).start()
+        try:
+            remote = RemoteStore(f"127.0.0.1:{gateway.port}")
+            pod = build_pod("ns", "p", "", objects.POD_PHASE_PENDING,
+                            {"cpu": "1"}, "")
+            remote.create(pod)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gateway.port}/apis/Pod/ns/p?epoch=abc",
+                method="DELETE")
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req, timeout=5)
+            assert exc_info.value.code == 400
+        finally:
+            gateway.stop()
+
+
+# ---------------------------------------------------------------------------
+# 2. the two-elector race
+# ---------------------------------------------------------------------------
+
+
+class TestTwoElectorRace:
+    def test_exactly_one_epochs_binds_land(self):
+        """Two electors race from scratch over one store; each side binds
+        with ITS elector's epoch. Only the winner's binds land — and
+        after a transition, only the NEW epoch's."""
+        store = Store()
+        ea = LeaderElector(
+            ResourceLock(store, "volcano-system", "vc-scheduler", "a"),
+            lambda: None, lambda: None, **FAST)
+        eb = LeaderElector(
+            ResourceLock(store, "volcano-system", "vc-scheduler", "b"),
+            lambda: None, lambda: None, **FAST)
+        ea.start()
+        eb.start()
+        try:
+            assert _wait(lambda: ea.is_leader() or eb.is_leader())
+            time.sleep(0.2)  # let the loser observe the lease
+            winner, loser = (ea, eb) if ea.is_leader() else (eb, ea)
+            assert not (ea.is_leader() and eb.is_leader()), "split brain"
+
+            def bind_with(elector, name):
+                import copy
+
+                store.create(build_pod(
+                    "ns", name, "", objects.POD_PHASE_PENDING,
+                    {"cpu": "1"}, ""))
+                # bind a CLONE, as the scheduler cache does — the store
+                # must stay pristine when the write is fenced
+                pod = copy.deepcopy(store.get("Pod", "ns", name))
+                binder = DefaultBinder(store)
+                binder.fence_epoch = elector.epoch()
+                binder.bind(pod, "n1")
+                return store.get("Pod", "ns", name)
+
+            assert bind_with(winner, "w1").spec.node_name == "n1"
+            with pytest.raises(FencedError):
+                bind_with(loser, "l1")  # epoch 0: never led
+            assert store.get("Pod", "ns", "l1").spec.node_name == ""
+
+            # transition: the winner releases, the loser takes over with
+            # a HIGHER epoch; the deposed term's stamp is now fenced
+            deposed_epoch = winner.epoch()
+            winner.stop()
+            assert _wait(loser.is_leader, timeout=3.0)
+            assert loser.epoch() > deposed_epoch
+            assert bind_with(loser, "l2").spec.node_name == "n1"
+            import copy
+
+            store.create(build_pod(
+                "ns", "w2", "", objects.POD_PHASE_PENDING,
+                {"cpu": "1"}, ""))
+            pod = copy.deepcopy(store.get("Pod", "ns", "w2"))
+            stale = DefaultBinder(store)
+            stale.fence_epoch = deposed_epoch
+            with pytest.raises(FencedError):
+                stale.bind(pod, "n1")
+            assert store.get("Pod", "ns", "w2").spec.node_name == ""
+        finally:
+            ea.stop()
+            eb.stop()
+
+    def test_elector_epoch_survives_loss(self):
+        """A deposed elector keeps its stale epoch (never regresses to
+        unfenced 0) so in-flight writes stay rejectable."""
+        store = Store()
+        el = LeaderElector(
+            ResourceLock(store, "volcano-system", "vc-scheduler", "a"),
+            lambda: None, lambda: None, **FAST)
+        el.start()
+        try:
+            assert _wait(el.is_leader)
+            epoch = el.epoch()
+            assert epoch >= 1
+        finally:
+            el.stop()
+        assert not el.is_leader()
+        assert el.epoch() == epoch
+
+
+# ---------------------------------------------------------------------------
+# 3. warm standby + FailoverScheduler
+# ---------------------------------------------------------------------------
+
+
+def _seed_cluster(store, pods=3):
+    store.create(build_queue("default"))
+    store.create(build_node(
+        "n1", build_resource_list_with_pods("8", "16Gi")))
+    store.create(build_pod_group("pg0", namespace="default", min_member=1))
+    for i in range(pods):
+        store.create(build_pod(
+            "default", f"seed-{i}", "", objects.POD_PHASE_PENDING,
+            {"cpu": "100m"}, "pg0"))
+
+
+class TestWarmStandby:
+    def test_follow_keeps_snapshot_incremental(self):
+        store = Store()
+        _seed_cluster(store)
+        cache = SchedulerCache(store=store, scheduler_name="volcano")
+        standby = WarmStandby(cache, follow_period=0.02).start()
+        try:
+            assert _wait(lambda: standby.stats["follows"] >= 3)
+            rebuilds0 = cache.snap_keeper.stats["rebuilds"]
+            # churn while following: deltas absorbed incrementally
+            store.create(build_pod(
+                "default", "late", "", objects.POD_PHASE_PENDING,
+                {"cpu": "100m"}, "pg0"))
+            follows = standby.stats["follows"]
+            assert _wait(lambda: standby.stats["follows"] >= follows + 2)
+            assert cache.snap_keeper.stats["rebuilds"] == rebuilds0, \
+                "standby follow paid a wholesale rebuild"
+            assert cache.snap_keeper.stats["incremental"] >= 2
+            # pause (leading): the loop stops following
+            standby.pause()
+            paused_at = standby.stats["follows"]
+            time.sleep(0.1)
+            assert standby.stats["follows"] <= paused_at + 1
+            standby.resume()
+            assert _wait(
+                lambda: standby.stats["follows"] > paused_at + 1)
+        finally:
+            standby.stop()
+            cache.detach_watches()
+
+    def test_failover_scheduler_moves_binding_authority(self):
+        """Two FailoverScheduler members over one store: the leader binds
+        under its fence epoch; on its death the warm standby takes over,
+        stamps the NEXT epoch, and binds — while the store's fence holds
+        the deposed term out."""
+        store = Store()
+        store.create(build_queue("default"))
+        store.create(build_node(
+            "n1", build_resource_list_with_pods("8", "16Gi")))
+
+        def member(identity):
+            cache = SchedulerCache(store=store, scheduler_name="volcano")
+            sched = Scheduler(cache, schedule_period=0.05)
+            return FailoverScheduler(
+                sched, store, identity=identity,
+                follow_period=0.05, **FAST)
+
+        a = member("a").start()
+        assert _wait(a.is_leader)
+        b = member("b").start()
+        try:
+            time.sleep(0.2)
+            assert not b.is_leader()
+            store.create(build_pod_group(
+                "pg1", namespace="default", min_member=1))
+            store.create(build_pod(
+                "default", "p1", "", objects.POD_PHASE_PENDING,
+                {"cpu": "1"}, "pg1"))
+            assert _wait(lambda: (store.get("Pod", "default", "p1")
+                                  .spec.node_name == "n1"), timeout=3.0)
+            epoch_a = a.elector.epoch()
+            assert a.scheduler.cache.fence_epoch == epoch_a
+            assert store.fence_epoch == epoch_a
+
+            a.stop()  # the active member dies; the standby must take over
+            assert _wait(b.is_leader, timeout=3.0)
+            assert b.elector.epoch() > epoch_a
+            assert b.scheduler.cache.fence_epoch == b.elector.epoch()
+            assert store.fence_epoch == b.elector.epoch()
+            store.create(build_pod_group(
+                "pg2", namespace="default", min_member=1))
+            store.create(build_pod(
+                "default", "p2", "", objects.POD_PHASE_PENDING,
+                {"cpu": "1"}, "pg2"))
+            assert _wait(lambda: (store.get("Pod", "default", "p2")
+                                  .spec.node_name == "n1"), timeout=3.0)
+            # the deposed term's stamp no longer writes
+            pod = store.get("Pod", "default", "p1")
+            with pytest.raises(FencedError):
+                store.update(pod, epoch=epoch_a)
+        finally:
+            a.stop()
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# 4. the degradation ladder
+# ---------------------------------------------------------------------------
+
+
+class TestBackoff:
+    def test_deterministic_capped_jittered(self):
+        a = degrade.Backoff("x", base=0.5, cap=4.0)
+        b = degrade.Backoff("x", base=0.5, cap=4.0)
+        da = [a.next_delay() for _ in range(8)]
+        db = [b.next_delay() for _ in range(8)]
+        assert da == db, "same name must retry identically (replay)"
+        assert degrade.Backoff("y", base=0.5, cap=4.0).next_delay() != da[0]
+        # jittered delays live in [peek*(1-jitter), peek], capped
+        c = degrade.Backoff("z", base=0.5, cap=4.0, jitter=0.5)
+        for i in range(10):
+            peek = c.peek()
+            assert peek <= 4.0
+            d = c.next_delay()
+            assert peek * 0.5 <= d <= peek
+        assert c.peek() == 4.0  # capped, not 0.5 * 2**10
+        c.reset()
+        assert c.peek() == 0.5
+        assert c.stats()["retries"] == 10
+        assert c.stats()["total_backoff_s"] > 0
+
+    def test_rejects_nonsense_parameters(self):
+        with pytest.raises(ValueError):
+            degrade.Backoff("x", base=0.0)
+        with pytest.raises(ValueError):
+            degrade.Backoff("x", base=2.0, cap=1.0)
+        with pytest.raises(ValueError):
+            degrade.Backoff("x", factor=0.5)
+
+
+class TestCircuitBreaker:
+    def test_open_halfopen_close_cycle_on_virtual_clock(self):
+        t = {"now": 1000.0}
+        clock.set_source(lambda: t["now"])
+        try:
+            br = degrade.CircuitBreaker("dep", threshold=3, cooldown_s=10.0)
+            assert br.allow()
+            br.record_failure()
+            br.record_failure()
+            assert br.state == degrade.CircuitBreaker.CLOSED
+            br.record_failure()
+            assert br.state == degrade.CircuitBreaker.OPEN
+            assert not br.allow()
+            t["now"] += 9.9
+            assert not br.allow()
+            t["now"] += 0.2  # cooldown elapsed: exactly one probe
+            assert br.allow()
+            assert br.state == degrade.CircuitBreaker.HALF_OPEN
+            br.record_failure()  # probe failed: straight back to OPEN
+            assert br.state == degrade.CircuitBreaker.OPEN
+            t["now"] += 10.1
+            assert br.allow()
+            br.record_success()
+            assert br.state == degrade.CircuitBreaker.CLOSED
+            assert br.stats["opens"] == 2
+            assert br.stats["probes"] == 2
+            assert br.stats["closes"] == 1
+        finally:
+            clock.set_source(None)
+
+    def test_success_resets_consecutive_failures(self):
+        br = degrade.CircuitBreaker("dep", threshold=3)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == degrade.CircuitBreaker.CLOSED
+
+
+class TestDegradeLadder:
+    def test_session_skip_budget_is_bounded(self):
+        ladder = degrade.DegradeLadder(
+            store_threshold=2, store_cooldown_s=1e9, max_session_skips=3)
+        for _ in range(2):
+            ladder.note_store_error()
+        assert ladder.rung() == "session_skip"
+        skips = [ladder.should_skip_session() for _ in range(4)]
+        # 3 skips then a FORCED session — a dead probe can never park the
+        # scheduler forever (bounded staleness)
+        assert skips == [True, True, True, False]
+        assert ladder.counters["sessions_skipped"] == 3
+        assert ladder.counters["forced_sessions"] == 1
+        ladder.note_store_ok()
+        assert ladder.rung() == ""
+        assert not ladder.should_skip_session()
+
+    def test_kernel_breaker_forces_serial_and_recovers(self):
+        t = {"now": 0.0}
+        clock.set_source(lambda: t["now"])
+        try:
+            ladder = degrade.DegradeLadder(
+                kernel_threshold=2, kernel_cooldown_s=5.0)
+            assert not ladder.force_serial()
+            ladder.note_kernel_failure()
+            ladder.note_kernel_failure()
+            assert ladder.force_serial()
+            assert ladder.rung() == "serial_host_solve"
+            t["now"] += 5.1
+            # the half-open probe lets exactly one dispatch through
+            assert not ladder.force_serial()
+            ladder.note_kernel_ok()
+            assert ladder.rung() == ""
+        finally:
+            clock.set_source(None)
+
+    def test_rungs_published_on_metrics(self):
+        metrics.reset()
+        ladder = degrade.DegradeLadder(store_threshold=1,
+                                       store_cooldown_s=1e9)
+        ladder.note_store_error()
+        body = metrics.render()
+        assert 'volcano_degraded_mode{rung="session_skip"} 1' in body
+        ladder.note_store_ok()
+        body = metrics.render()
+        assert 'volcano_degraded_mode{rung="session_skip"} 0' in body
+
+    def test_process_default_ladder_shared_and_resettable(self):
+        ladder = degrade.default_ladder()
+        assert degrade.default_ladder() is ladder
+        degrade.note_kernel_failure()
+        assert ladder.counters["per_action_fallbacks"] == 1
+        degrade.reset()
+        assert degrade.default_ladder() is not ladder
+
+
+class TestSchedulerSessionSkip:
+    def test_loop_skips_then_forces_bounded_staleness_session(self):
+        store = Store()
+        _seed_cluster(store, pods=1)
+        cache = SchedulerCache(store=store, scheduler_name="volcano")
+        sched = Scheduler(cache, schedule_period=0.02)
+        ladder = sched.degrade
+        ladder.max_session_skips = 4
+        for _ in range(ladder.store.threshold):
+            ladder.note_store_error()  # remote store declared down
+        assert ladder.rung() == "session_skip"
+        sched.run()
+        try:
+            # the loop skips while the breaker holds, then the staleness
+            # budget forces a session; that session succeeds against the
+            # in-process store and closes the breaker
+            assert _wait(
+                lambda: ladder.counters["forced_sessions"] >= 1,
+                timeout=5.0)
+            assert ladder.counters["sessions_skipped"] >= 4
+            assert _wait(lambda: ladder.rung() == "", timeout=5.0)
+        finally:
+            sched.stop()
+
+
+class TestRemoteWatchBackoff:
+    def test_poll_failures_back_off_and_surface_counters(self):
+        # no gateway behind this address: every poll errors; the retry
+        # loop must back off (never fixed-interval hammer) and meter it
+        remote = RemoteStore("127.0.0.1:1", timeout=0.2)
+        from volcano_tpu.store.store import WatchHandler
+
+        remote.watch("Pod", WatchHandler(), poll_timeout=0.05)
+        try:
+            assert _wait(
+                lambda: remote.watch_stats()["poll_errors"] >= 3,
+                timeout=10.0)
+            stats = remote.watch_stats()
+            assert stats["backoff_s"] > 0
+            assert stats["max_backoff_s"] > 0
+            assert stats["polls"] == 0
+        finally:
+            remote.stop_watches()
+
+    def test_healthy_polls_do_not_back_off(self):
+        store = Store()
+        gateway = ApiGateway(store).start()
+        try:
+            remote = RemoteStore(f"127.0.0.1:{gateway.port}")
+            from volcano_tpu.store.store import WatchHandler
+
+            remote.watch("Pod", WatchHandler(), poll_timeout=0.05)
+            assert _wait(lambda: remote.watch_stats()["polls"] >= 2,
+                         timeout=10.0)
+            stats = remote.watch_stats()
+            assert stats["poll_errors"] == 0
+            assert stats["backoff_s"] == 0.0
+            remote.stop_watches()
+        finally:
+            gateway.stop()

@@ -190,6 +190,59 @@ class TestSmokeScenarios:
         assert a["express"]["placed"] == b["express"]["placed"]
         assert a["express"]["reverted"] == b["express"]["reverted"]
 
+    def test_ha_failover_fenced_takeovers_clean(self):
+        """ha_failover smoke (reduced scale): three leader kills — one
+        mid-defer-window, one mid-fused-chain, one mid-express-commit —
+        each promoting the warm standby via the real resource-lock CAS,
+        with the auditor holding the fencing balance and the takeover
+        bounds through mirror 5xx storms."""
+        cfg = scale_scenario(load_scenario("ha_failover"), 0.5)
+        s = SimCluster(cfg, seed=7).run()
+        assert s["audit"]["violations"] == 0, s["audit"]
+        ha = s["ha"]
+        assert ha is not None
+        # every injected seam actually deposed a leader
+        assert ha["leader_kills"].get("mid_defer", 0) >= 1, ha
+        assert ha["leader_kills"].get("mid_chain", 0) >= 1, ha
+        assert ha["leader_kills"].get("mid_express", 0) >= 1, ha
+        assert sum(ha["leader_kills"].values()) >= 3
+        assert ha["epoch"] >= 4  # epoch 1 + three takeovers
+        # the fence actually fired (a deposed term's in-flight writes
+        # were rejected) and the rejection ledger balances exactly
+        fence = ha["fence"]
+        assert fence["rejected"] >= 1, fence
+        assert fence["rejected"] == fence["observed_by_effectors"], fence
+        assert fence["epoch"] == ha["epoch"]
+        # every takeover met the warm-standby contract: first led session
+        # within <= 2 cycle periods, zero wholesale rebuilds, zero
+        # recompiles, deposed-term express tokens drained
+        assert len(ha["takeovers"]) == 3, ha["takeovers"]
+        period = cfg["scheduler"]["period_s"]
+        for t in ha["takeovers"]:
+            assert t["first_session_at"] is not None, t
+            assert t["first_session_at"] - t["at"] <= 2 * period + 1e-9, t
+            assert t["rebuilds_delta"] == 0, t
+            assert t["first_session_compiles"] == 0, t
+            assert t["undrained_tokens"] == [], t
+        # the 5xx storm raged (polls dropped) yet mirrors converged
+        assert s["mirrors"]["Pod"]["dropped_polls"] >= 1, s["mirrors"]
+
+    def test_ha_failover_same_seed_identical_hash(self):
+        def strip_warmth(t):
+            # first_session_compiles reflects process jit-cache warmth
+            # (run b inherits run a's compiled buckets) — everything else
+            # about a takeover must replay exactly
+            return {k: v for k, v in t.items()
+                    if k != "first_session_compiles"}
+
+        cfg = scale_scenario(load_scenario("ha_failover"), 0.25)
+        a = SimCluster(cfg, seed=5).run(duration=60.0)
+        b = SimCluster(cfg, seed=5).run(duration=60.0)
+        assert a["event_log_hash"] == b["event_log_hash"]
+        assert a["ha"]["fence"] == b["ha"]["fence"]
+        assert [strip_warmth(t) for t in a["ha"]["takeovers"]] \
+            == [strip_warmth(t) for t in b["ha"]["takeovers"]]
+
 
 # ---------------------------------------------------------------------------
 # 3. auditor self-test (seeded bug fixtures)
@@ -268,6 +321,15 @@ class TestCfg5Scale:
         ex = s["express"]
         assert ex["placed"] > 20, ex
         assert s["binds"] > ex["placed"]
+
+    @pytest.mark.slow
+    def test_full_scale_ha_failover(self):
+        cfg = copy.deepcopy(load_scenario("ha_failover"))
+        s = SimCluster(cfg, seed=7, repro_dir=None).run()
+        assert s["audit"]["violations"] == 0, s["audit"]
+        assert sum(s["ha"]["leader_kills"].values()) >= 3
+        assert s["ha"]["fence"]["rejected"] \
+            == s["ha"]["fence"]["observed_by_effectors"]
 
     @pytest.mark.slow
     def test_chaos_soak_two_hours(self):

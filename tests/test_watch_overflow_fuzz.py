@@ -86,6 +86,146 @@ class TestJournalPollProtocol:
         assert not reset and events == []
 
 
+class TestJournalSquash:
+    """MODIFIED-squash backpressure: while no poll has served a key's
+    latest MODIFIED, a newer MODIFIED coalesces into it in place — a
+    status-churn storm against a slow watcher costs one ring entry per
+    pod, not one per update, so bounded journals stop forcing spurious
+    410 resets. Served entries are immutable; resets freeze the whole
+    ring prefix (a squash into the reset gap would lose a final state)."""
+
+    def test_modified_storm_coalesces_instead_of_overflowing(self):
+        store = Store()
+        mirror = JournalMirror(store, "Pod", cap=32)
+        live: dict = {}
+        for i in range(8):
+            pod = _make_pod(i)
+            store.create(pod)
+            live[object_key(pod)] = pod
+        # the watcher never drains while 20 no-op update rounds hammer
+        # every pod: 160 MODIFIEDs squash to at most one live entry per
+        # pod, so the 32-slot ring never rolls past the cursor
+        import copy
+
+        for round_no in range(20):
+            for key in sorted(live):
+                pod = copy.deepcopy(live[key])
+                pod.metadata.annotations["storm"] = str(round_no)
+                live[key] = store.update(pod)
+        mirror.catch_up()
+        assert mirror.resets == 0, \
+            "squash failed: the storm rolled the ring and forced a reset"
+        assert mirror.journal.squashed >= 100, mirror.journal.squashed
+        diff = mirror.diff_vs_store()
+        assert diff == {"phantom": [], "missing": [], "stale": []}, diff
+
+    def test_served_entries_are_immutable(self):
+        """A MODIFIED the consumer already received must not be rewritten:
+        the follow-up update appends instead, and both states arrive in
+        order."""
+        import copy
+
+        store = Store()
+        journal = _WatchJournal(store, "Pod", cap=32)
+        pod = _make_pod(0)
+        store.create(pod)
+        pod = copy.deepcopy(pod)
+        pod.metadata.annotations["v"] = "1"
+        pod = store.update(pod)
+        from volcano_tpu.api import codec
+
+        events, nxt, reset = journal.poll(0, 0.0)
+        assert not reset and len(events) == 2  # ADDED + MODIFIED, served
+        v1 = codec.from_envelope(
+            events[1]["object"]).metadata.resource_version
+        pod = copy.deepcopy(pod)
+        pod.metadata.annotations["v"] = "2"
+        pod = store.update(pod)
+        # the served MODIFIED kept v1; the new state came as a NEW entry
+        events2, _, reset = journal.poll(nxt, 0.0)
+        assert not reset and len(events2) == 1
+        assert codec.from_envelope(
+            events[1]["object"]).metadata.resource_version == v1
+        assert codec.from_envelope(
+            events2[0]["object"]).metadata.resource_version \
+            == pod.metadata.resource_version
+        assert journal.squashed == 0
+
+    def test_reset_freezes_ring_against_late_squash(self):
+        """Regression: after a reset tells a client to re-list and resume
+        from ``end``, a later MODIFIED must NOT squash into a ring entry
+        below ``end`` — the client would never see that final state (it
+        happened after the re-list read the store)."""
+        import copy
+
+        store = Store()
+        mirror = JournalMirror(store, "Pod", cap=8)
+        live: dict = {}
+        for i in range(4):
+            pod = _make_pod(i)
+            store.create(pod)
+            live[object_key(pod)] = pod
+        mirror.catch_up()
+        # roll the ring past the cursor, with a MODIFIED for pod-0 still
+        # IN the ring when the reset fires
+        for i in range(4, 12):
+            pod = _make_pod(i)
+            store.create(pod)
+            live[object_key(pod)] = pod
+        key0 = sorted(live)[0]
+        pod = copy.deepcopy(live[key0])
+        pod.metadata.annotations["gen"] = "in-ring"
+        live[key0] = store.update(pod)
+        _, reset_taken = mirror.poll_once()
+        assert reset_taken, "cursor should have fallen off the ring"
+        # the state that changes AFTER the re-list: without the freeze it
+        # squashes into the in-ring entry behind the client's new cursor
+        pod = copy.deepcopy(live[key0])
+        pod.metadata.annotations["gen"] = "after-relist"
+        live[key0] = store.update(pod)
+        mirror.catch_up()
+        diff = mirror.diff_vs_store()
+        assert diff["stale"] == [], \
+            f"post-reset squash swallowed a final state: {diff}"
+
+    @pytest.mark.parametrize("seed", [11, 12, 13])
+    def test_update_heavy_fuzz_converges_with_squashes(self, seed):
+        """Update-biased churn against a frequently-skipping consumer:
+        squashing must actually engage AND the protocol still converges
+        exactly (squash can reorder nothing, lose nothing)."""
+        import copy
+
+        rng = random.Random(seed)
+        store = Store()
+        mirror = JournalMirror(store, "Pod", cap=16)
+        live: dict = {}
+        idx = 0
+        for _ in range(50):
+            for _ in range(rng.randrange(1, 30)):
+                roll = rng.random()
+                if not live or roll < 0.15:
+                    pod = _make_pod(idx)
+                    store.create(pod)
+                    live[object_key(pod)] = pod
+                    idx += 1
+                elif roll < 0.9:
+                    key = rng.choice(sorted(live))
+                    pod = copy.deepcopy(live[key])
+                    pod.metadata.annotations["fuzz"] = str(idx)
+                    live[key] = store.update(pod)
+                    idx += 1
+                else:
+                    key = rng.choice(sorted(live))
+                    ns, name = key.split("/", 1)
+                    store.delete("Pod", ns, name)
+                    del live[key]
+            mirror.drain(rng=rng, skip_prob=0.6, error_prob=0.2)
+        assert mirror.journal.squashed > 0, "fuzz never exercised squash"
+        mirror.catch_up()
+        diff = mirror.diff_vs_store()
+        assert diff == {"phantom": [], "missing": [], "stale": []}, diff
+
+
 class TestLocalMirrorFuzz:
     @pytest.mark.parametrize("seed", [1, 2, 3])
     def test_lagging_consumer_converges(self, seed):

@@ -409,7 +409,14 @@ class LockDiscipline(Rule):
 
     id = "VT003"
     title = "lock-discipline violation"
-    patterns = ("*/controllers/*.py", "*/scheduler/cache/*.py")
+    patterns = ("*/controllers/*.py", "*/scheduler/cache/*.py",
+                # the HA stack holds its own locks (elector record lock,
+                # breaker state lock) while sitting UNDER the cache/store
+                # locks in the callback graph — the same inversion rules
+                # apply (scheduler/ha.py elector callbacks fire on the
+                # elector thread; degrade.py gates run inside sessions)
+                "*/scheduler/ha.py", "*/scheduler/degrade.py",
+                "*/scheduler/leaderelection.py")
 
     _LOCK_ATTR = re.compile(r"(^|_)(lock|mu|mutex|cond)$")
     STORE_MUTATORS = {
@@ -641,7 +648,13 @@ class HotPathDeterminism(Rule):
                 # express classification/commit order feeds real binds:
                 # set-order nondeterminism here diverges replicas exactly
                 # like encoder nondeterminism would
-                "*/express/*.py")
+                "*/express/*.py",
+                # HA decisions (who leads, which rung, what gets fenced)
+                # must replay byte-identically under the sim's same-seed
+                # hash contract — set-order nondeterminism in takeover or
+                # degradation paths would fork active and standby
+                "*/scheduler/ha.py", "*/scheduler/degrade.py",
+                "*/scheduler/leaderelection.py")
 
     _SET_CTORS = {"set", "frozenset"}
     _SET_METHODS = {"union", "intersection", "difference",

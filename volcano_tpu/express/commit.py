@@ -27,6 +27,7 @@ import numpy as np
 
 from volcano_tpu.api.types import TaskStatus
 from volcano_tpu.express.trigger import ExpressToken
+from volcano_tpu.store import FencedError
 from volcano_tpu.utils import clock
 
 logger = logging.getLogger(__name__)
@@ -55,12 +56,28 @@ def commit_batch(cache, lane, jobs: List[Tuple[object, list]],
     # and the binder's store write dispatches synchronous watch callbacks
     # whose handlers re-enter the cache — holding the lock across that is
     # the ABBA inversion VT003 exists to prevent
-    for job, plan in plans:
+    fenced = False
+    for ji, (job, plan) in enumerate(plans):
         binds: Dict[str, Tuple[str, str]] = {}
         ok = True
         for task, node_name in plan:
             try:
                 cache.bind(task, node_name)
+            except FencedError:
+                # the lease moved mid-commit (a deposed leader's express
+                # batch): the store fenced this bind, so STOP the whole
+                # batch and park the lane — every remaining write would
+                # burn one rejection to learn the same thing. Binds that
+                # already landed belong to this job's token below; the
+                # NEW leader's first session reconciles (and reverts)
+                # them through the ordinary token drain.
+                logger.warning(
+                    "express commit fenced (lease lost) at %s; parking "
+                    "lane", task.uid)
+                lane.park("lease_lost")
+                ok = False
+                fenced = True
+                break
             except Exception:
                 # a raced mutation beat the bind; the remainder of this
                 # gang is NOT dispatched — reconcile reverts the partial
@@ -75,6 +92,9 @@ def commit_batch(cache, lane, jobs: List[Tuple[object, list]],
                 stamp=clock.now())
         if not ok:
             deferred += 1
+        if fenced:
+            deferred += len(plans) - ji - 1  # undispatched remainder
+            break
     return placed, deferred
 
 

@@ -109,6 +109,16 @@ class ExpressLane:
         self.wake = threading.Event()
         self.outstanding: Dict[str, ExpressToken] = {}
         self.denylist: set = set()
+        # failover hygiene + degradation: a parked lane defers every
+        # arrival to full sessions (lease loss parks it; re-acquire/
+        # promote unparks), and the error breaker auto-parks after
+        # repeated batch failures, recovering via its half-open probe
+        # (the express_disabled rung, scheduler/degrade.py)
+        self._park_reason: Optional[str] = None
+        from volcano_tpu.scheduler.degrade import CircuitBreaker
+
+        self.breaker = CircuitBreaker("express-lane", threshold=3,
+                                      cooldown_s=30.0)
         # (job_uid, task_key, node_name) triples from the most recent
         # reconcile's reverts — the auditor's zero-residue probe
         self.last_reverts: List[Tuple[str, str, str]] = []
@@ -150,6 +160,23 @@ class ExpressLane:
                 # is one continuous series even when the cache is not
                 for k, v in old_stats.items():
                     self.state.stats[k] += v
+
+    def park(self, reason: str = "parked") -> None:
+        """Suspend the fast path (arrivals defer to full sessions) without
+        losing state: outstanding tokens still owe the next session a
+        verdict, the queue keeps accumulating, and the device buffers stay
+        warm for unpark. Called on lease loss — a deposed leader must not
+        keep optimistically binding — and by the error breaker."""
+        self._park_reason = reason
+
+    def unpark(self) -> None:
+        self._park_reason = None
+        if self.has_pending():
+            self.wake.set()
+
+    @property
+    def parked(self) -> bool:
+        return self._park_reason is not None
 
     def set_tiers(self, tiers) -> None:
         """Gate the lane on the session conf: any plugin outside the
@@ -247,9 +274,16 @@ class ExpressLane:
         rep.queued = len(uids)
         if not uids:
             return rep.as_dict()
-        if not self.enabled:
+        reason = None
+        if self._park_reason is not None:
+            reason = f"parked:{self._park_reason}"
+        elif not self.enabled:
+            reason = "lane_disabled"
+        elif not self.breaker.allow():
+            reason = "circuit_open"
+        if reason is not None:
             rep.deferred = len(uids)
-            rep.reasons["lane_disabled"] = len(uids)
+            rep.reasons[reason] = len(uids)
             self.counters["deferred"] += len(uids)
             metrics.register_express_deferred(len(uids))
             return rep.as_dict()
@@ -257,11 +291,17 @@ class ExpressLane:
             self._run_batch(uids, rep)
         except Exception:
             # any device/encode failure defers the whole batch to the next
-            # full session — express is an accelerator, never a gate
+            # full session — express is an accelerator, never a gate; the
+            # breaker turns PERSISTENT failure into an auto-park
+            # (express_disabled rung) instead of a doomed dispatch per wake
             logger.exception("express batch failed; deferring to session")
             self.counters["errors"] += 1
+            self.breaker.record_failure()
             rep.deferred += rep.queued - rep.placed - rep.deferred
             rep.reasons["error"] = rep.reasons.get("error", 0) + 1
+        else:
+            if rep.batches:
+                self.breaker.record_success()
         rep.ms = (time.perf_counter() - t0) * 1e3
         self.latencies_ms.append(rep.ms)
         metrics.observe_express_latency(rep.ms / 1e3)
@@ -380,4 +420,6 @@ class ExpressLane:
         return {"counters": dict(self.counters),
                 "latency_ms": self.latency_percentiles(),
                 "state": dict(self.state.stats) if self.state else {},
-                "outstanding": len(self.outstanding)}
+                "outstanding": len(self.outstanding),
+                "parked": self._park_reason or "",
+                "breaker": self.breaker.state}

@@ -340,7 +340,17 @@ class BatchAllocator:
         spec/layout/staged through its own chained program — or None after
         recording the fallback reason in the profile (the caller then runs
         the serial loop)."""
+        from volcano_tpu.scheduler import degrade
+
         t0 = time.perf_counter()
+        if degrade.force_serial():
+            # the kernel circuit breaker is OPEN (persistent device/compile
+            # failure — the serial_host_solve rung): skip the doomed
+            # dispatch entirely; allow()'s half-open probe re-enables the
+            # device path automatically after the cooldown
+            self.profile["fallback"] = (
+                "degraded: kernel circuit open; serial host solve")
+            return None
         if self.mode in ("rounds", "auto"):
             # the bulk writeback (_apply_bulk) bypasses the Statement event
             # machinery and hardcodes drf/proportion share updates; a
@@ -438,6 +448,7 @@ class BatchAllocator:
         except Exception as e:  # any device/compile failure -> serial oracle
             logger.exception("tpuscore prepare failed; falling back to serial")
             self.profile["fallback"] = f"solve error: {e}"
+            degrade.note_kernel_failure()
             return None
         return prep
 
@@ -550,7 +561,13 @@ class BatchAllocator:
         except Exception as e:  # any device/compile failure -> serial oracle
             logger.exception("tpuscore solve failed; falling back to serial")
             self.profile["fallback"] = f"solve error: {e}"
+            from volcano_tpu.scheduler import degrade
+
+            degrade.note_kernel_failure()
             return False
+        from volcano_tpu.scheduler import degrade
+
+        degrade.note_kernel_ok()
 
         if mode == "rounds":
             return self.apply_packed(ssn, prep, assign, meta)
@@ -955,28 +972,43 @@ class BatchAllocator:
                 retry_from = 0
         else:
             retry_from = 0
+        failed_binds: set = set()
         if retry_from is not None:
             # per-task so one bad pod degrades to resync, not a lost
-            # session (cache.go:597-599 semantics)
-            for task, host in zip(bind_tasks[retry_from:],
-                                  bind_hosts[retry_from:]):
+            # session (cache.go:597-599 semantics); failures are tracked
+            # so the event record below stays bind-exact — a fenced
+            # (deposed-leader) or otherwise failed bind must not leave a
+            # phantom Scheduled event behind
+            for k, (task, host) in enumerate(
+                    zip(bind_tasks[retry_from:], bind_hosts[retry_from:]),
+                    start=retry_from):
                 try:
                     binder.bind(task.pod, host)
                 except Exception:
                     cache.resync_task(task)
+                    failed_binds.add(k)
         if cache.store is not None:
+            event_keys, event_hosts, event_tasks = (
+                bind_keys, bind_hosts, bind_tasks)
+            if failed_binds:
+                event_keys = [k for i, k in enumerate(bind_keys)
+                              if i not in failed_binds]
+                event_hosts = [h for i, h in enumerate(bind_hosts)
+                               if i not in failed_binds]
+                event_tasks = [t for i, t in enumerate(bind_tasks)
+                               if i not in failed_binds]
             record_scheduled = getattr(cache.store, "record_scheduled", None)
             if record_scheduled is not None:
                 # lazy batch record: the Scheduled message materializes on
                 # read, not on the session's critical path (the reference
                 # recorder is an async broadcaster — cache.go:601-611)
-                record_scheduled(bind_keys, bind_hosts)
+                record_scheduled(event_keys, event_hosts)
             else:
                 cache.store.record_events(
                     (task.pod, "Normal", "Scheduled",
                      f"Successfully assigned "
                      f"{task.namespace}/{task.name} to {host}")
-                    for task, host in zip(bind_tasks, bind_hosts))
+                    for task, host in zip(event_tasks, event_hosts))
 
         if enc.spec.use_exclusion:
             # device-placed exclusion-group pods carry required

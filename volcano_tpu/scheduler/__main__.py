@@ -142,10 +142,13 @@ def seed_cluster_state(store, path: str) -> None:
             job_cli.run_job(store, yaml.safe_dump(doc))
 
 
-def _make_elector(args, store, run_workload, stop_workload):
+def _make_elector(args, store, run_workload, stop_workload, fence=None):
     """Leader-elect wiring shared by the in-process and remote modes:
     identity derivation, the store-backed ConfigMap lock, and the elector
-    whose callbacks start/stop the mode's workload."""
+    whose callbacks start/stop the mode's workload. ``fence`` (called
+    with the acquired epoch BEFORE the workload starts) stamps the
+    fencing token onto the effector write-path, so no session of the new
+    term ever writes unfenced (store/store.py FencedError)."""
     import os
     import socket
 
@@ -156,10 +159,18 @@ def _make_elector(args, store, run_workload, stop_workload):
                 or f"{socket.gethostname()}-{os.getpid()}")
     lock = ResourceLock(
         store, args.lock_object_namespace, args.scheduler_name, identity)
+    holder = {}
+
+    def on_started():
+        if fence is not None:
+            fence(holder["elector"].epoch())
+        run_workload()
+
     elector = LeaderElector(
         lock,
-        on_started_leading=run_workload,
+        on_started_leading=on_started,
         on_stopped_leading=stop_workload)
+    holder["elector"] = elector
     elector.start()
     logging.info("leader election enabled (identity=%s)", identity)
     return elector
@@ -222,29 +233,39 @@ def run_remote_scheduler(args) -> int:
         scheduler.conf_path = args.scheduler_conf
 
     stop_evt = threading.Event()
-    elector = None
+    ha_member = None
     metrics_srv = ObservabilityServer(args.listen_address).start()
     healthz_srv = ObservabilityServer(
         args.healthz_address,
         healthy=lambda: not stop_evt.is_set()
-        and (elector is None or elector.healthy())
+        and (ha_member is None or ha_member.healthy())
         and remote.healthy(timeout=2.0)).start()
     logging.info(
         "remote scheduler against %s; metrics on :%d/metrics, healthz on "
         ":%d/healthz", args.server, metrics_srv.port, healthz_srv.port)
 
     if args.leader_elect:
-        # the lock ConfigMap lives in the REMOTE store: competing
-        # scheduler processes on different hosts CAS the same record
-        # through the gateway, exactly client-go against the API server
-        elector = _make_elector(args, remote, scheduler.run, scheduler.stop)
+        # the full HA member shape (scheduler/ha.py): the lock ConfigMap
+        # lives in the REMOTE store — competing scheduler processes CAS
+        # the same record through the gateway, the gateway's store
+        # advances its fence from the winning lease, and the loser's
+        # in-flight writes are rejected server-side. While standby, the
+        # cache keeps following the watch stream and the snapshot keeper
+        # stays warm for a bounded takeover.
+        from volcano_tpu.scheduler.ha import FailoverScheduler
+
+        ha_member = FailoverScheduler(
+            scheduler, remote,
+            lock_namespace=args.lock_object_namespace,
+            lock_name=args.scheduler_name,
+            identity=args.leader_elect_identity).start()
     else:
         scheduler.run()
 
     _wait_for_signal_or_deadline(args, stop_evt)
 
-    if elector is not None:
-        elector.stop()
+    if ha_member is not None:
+        ha_member.stop()
     else:
         scheduler.stop()
     remote.flush_events()
@@ -322,7 +343,8 @@ def main(argv=None) -> int:
         elector = _make_elector(
             args, cluster.store,
             lambda: cluster.run(scheduling=not args.api_server_only),
-            cluster.stop)
+            cluster.stop,
+            fence=cluster.cache.set_fence_epoch)
     else:
         cluster.run(scheduling=not args.api_server_only)
 

@@ -29,7 +29,7 @@ from volcano_tpu.api.queue_info import QueueInfo
 from volcano_tpu.api.types import TaskStatus, allocated_status
 from volcano_tpu.api.unschedule_info import ALL_NODE_UNAVAILABLE
 from volcano_tpu.scheduler.cache.interface import BindManyError
-from volcano_tpu.store import NotFoundError, Store, WatchHandler
+from volcano_tpu.store import FencedError, NotFoundError, Store, WatchHandler
 
 
 def _add_res_vec(res, vec, sign: float, scalar_names) -> None:
@@ -58,14 +58,28 @@ def pod_group_job_id(pg: objects.PodGroup) -> str:
 
 
 class DefaultBinder:
-    """Commit placement by setting spec.node_name (the Bind subresource)."""
+    """Commit placement by setting spec.node_name (the Bind subresource).
+
+    ``fence_epoch`` stamps every bind with the leadership epoch that
+    authorized it (None = fencing off): a deposed leader finishing an
+    in-flight fused chain cannot double-bind — the store rejects the
+    stale stamp (FencedError) and the failure feeds the ordinary
+    resync/rewind machinery. Rejections are counted per instance so the
+    failover auditor can balance them against the store's accounting."""
+
+    fence_epoch = None
 
     def __init__(self, store: Store):
         self.store = store
+        self.fenced_rejections = 0
 
     def bind(self, pod: objects.Pod, hostname: str) -> None:
         pod.spec.node_name = hostname
-        self.store.update(pod)
+        try:
+            self.store.update(pod, epoch=self.fence_epoch)
+        except FencedError:
+            self.fenced_rejections += 1
+            raise
 
     def bind_many(self, pairs) -> None:
         """Batch bind; reports partial progress so a mid-batch failure only
@@ -81,26 +95,41 @@ class DefaultBinder:
 
 class DefaultEvictor:
     """Graceful deletion: stamp deletion_timestamp; the kubelet analog
-    completes the termination."""
+    completes the termination. Evictions are fenced exactly like binds —
+    a deposed leader must not terminate pods the new leader just placed
+    or re-affirmed."""
+
+    fence_epoch = None
 
     def __init__(self, store: Store):
         self.store = store
+        self.fenced_rejections = 0
 
     def evict(self, pod: objects.Pod, reason: str = "") -> None:
         from volcano_tpu.utils import clock
 
         pod.metadata.deletion_timestamp = clock.now()
-        self.store.update(pod)
+        try:
+            self.store.update(pod, epoch=self.fence_epoch)
+        except FencedError:
+            self.fenced_rejections += 1
+            raise
 
 
 class DefaultStatusUpdater:
     """Status writebacks tolerate deletion races: the snapshot a session
     closes against can be a full cycle stale, and an object deleted in the
     meantime makes its status update moot, not an error — the reference's
-    updater logs update failures and moves on (job_updater.go:44-52)."""
+    updater logs update failures and moves on (job_updater.go:44-52).
+    Fenced rejections are likewise moot-but-counted: a deposed leader's
+    close-time condition/status writes must degrade to accounting, not
+    crash the close path or overwrite the new leader's truth."""
+
+    fence_epoch = None
 
     def __init__(self, store: Store):
         self.store = store
+        self.fenced_rejections = 0
 
     def update_pod_condition(self, pod: objects.Pod, condition) -> None:
         for i, c in enumerate(pod.status.conditions):
@@ -110,7 +139,9 @@ class DefaultStatusUpdater:
         else:
             pod.status.conditions.append(condition)
         try:
-            self.store.update(pod)
+            self.store.update(pod, epoch=self.fence_epoch)
+        except FencedError:
+            self.fenced_rejections += 1
         except NotFoundError:
             pass  # pod deleted since the session snapshot
 
@@ -118,7 +149,9 @@ class DefaultStatusUpdater:
         if status is not None:
             pod_group.status = status
         try:
-            self.store.update_status(pod_group)
+            self.store.update_status(pod_group, epoch=self.fence_epoch)
+        except FencedError:
+            self.fenced_rejections += 1
         except NotFoundError:
             pass  # pod group deleted since the session snapshot
 
@@ -307,6 +340,37 @@ class SchedulerCache:
         # from the watch handlers and must only enqueue
         self.express_lane = None
         self._arrival_listener = None
+        # lease-epoch fencing (store/store.py): the epoch stamped onto
+        # every effector write of the current leadership term, and the
+        # count of writes the store rejected as stale (split-brain
+        # attempts that the fence turned into ordinary effector failures)
+        self.fence_epoch = None
+        self.fenced_writes = 0
+        # a new leadership term owes the cluster one recovery sweep: the
+        # first session after set_fence_epoch reverts any half-bound gang
+        # a deposed leader's fenced mid-chain abort left in the store
+        # (framework.run_actions consumes this flag)
+        self.fence_sweep_due = False
+
+    def set_fence_epoch(self, epoch) -> None:
+        """Stamp this cache's effector write-path with a leadership epoch
+        (None disarms). Called on lease acquisition BEFORE the session
+        loop starts, and deliberately NOT on loss — a deposed term's
+        in-flight writes must keep their stale stamp so the store fences
+        them, instead of regressing to unfenced authority."""
+        self.fence_epoch = epoch
+        self.fence_sweep_due = epoch is not None
+        for effector in (self.binder, self.evictor, self.status_updater):
+            if effector is not None and hasattr(effector, "fence_epoch"):
+                effector.fence_epoch = epoch
+
+    def fenced_rejections(self) -> int:
+        """Fenced-write rejections observed through this cache's effectors
+        plus the bulk-writeback path (the auditor's balance probe)."""
+        total = self.fenced_writes
+        for effector in (self.binder, self.evictor, self.status_updater):
+            total += getattr(effector, "fenced_rejections", 0)
+        return total
 
     def set_arrival_listener(self, fn) -> None:
         """Register the express lane's arrival callback: fn(job_uid) is
@@ -646,6 +710,14 @@ class SchedulerCache:
                 pod = task.pod
         try:
             self.binder.bind(pod, hostname)
+        except FencedError:
+            # deposed leadership: undo the cache-side flip via resync and
+            # RE-RAISE so batch callers (express commit) stop dispatching
+            # the rest of a doomed gang instead of burning one rejection
+            # per task — per-task callers (Statement commit) already treat
+            # a bind failure as non-fatal
+            self.resync_task(task)
+            raise
         except Exception:
             self.resync_task(task)
         else:
@@ -671,6 +743,9 @@ class SchedulerCache:
                 pod = task.pod
         try:
             self.evictor.evict(pod, reason)
+        except FencedError:
+            self.resync_task(task)
+            raise  # see bind(): deposed leadership stops the batch
         except Exception:
             self.resync_task(task)
         else:

@@ -6,6 +6,7 @@ import logging
 import time
 from typing import List
 
+from volcano_tpu.api.types import TaskStatus, allocated_status
 from volcano_tpu.scheduler import conf
 from volcano_tpu.scheduler import metrics
 from volcano_tpu.scheduler.framework.arguments import Arguments
@@ -44,6 +45,46 @@ def open_session(cache, tiers: List[conf.Tier]) -> Session:
     return ssn
 
 
+def takeover_recovery_sweep(ssn) -> int:
+    """First session of a new leadership term: revert the half-bound gangs
+    a deposed leader's fenced mid-chain abort may have left in the store.
+
+    A leader killed between two binds of one gang's fused chain (or serial
+    Statement commit) leaves 0 < bound < minAvailable pods with node_name
+    set — pods the deposed term can no longer touch (its writes are
+    fenced) and that would otherwise violate gang atomicity until chance
+    capacity completes them. The new term evicts them through the ordinary
+    Statement machinery (same fidelity as an express revert: events, cache
+    accounting, dirty-sets, metrics), freeing the capacity for THIS
+    session's own placements; the job controller's normal recovery
+    resubmits the gang for atomic re-placement. Jobs with any terminal
+    task are lifecycle churn, not failover residue — skipped, exactly as
+    the auditor's gang rule exempts them. Returns gangs reverted."""
+    terminal = TaskStatus.SUCCEEDED | TaskStatus.FAILED
+    reverted = 0
+    for job_uid in sorted(ssn.jobs):
+        job = ssn.jobs[job_uid]
+        if job.min_available <= 1:
+            continue
+        tasks = [job.tasks[uid] for uid in sorted(job.tasks)]
+        if any(t.status & terminal for t in tasks):
+            continue
+        bound = [t for t in tasks
+                 if allocated_status(t.status) and t.node_name]
+        if not bound or len(bound) >= job.min_available:
+            continue
+        stmt = ssn.statement()
+        for task in bound:
+            stmt.evict(task, "takeover-recovery: gang short after failover")
+        stmt.commit()
+        reverted += 1
+    if reverted:
+        logger.warning(
+            "takeover recovery: reverted %d half-bound gang(s) left by a "
+            "deposed leader", reverted)
+    return reverted
+
+
 def run_actions(ssn: Session, actions) -> dict:
     """Run the session's action chain, preferring the whole-session fused
     dispatch (ops/session_fuse.py) when the session is inside its envelope;
@@ -62,6 +103,10 @@ def run_actions(ssn: Session, actions) -> dict:
 
         ssn.cache.express_lane.set_tiers(ssn.tiers)
         reconcile_session(ssn)
+    if getattr(ssn.cache, "fence_sweep_due", False):
+        # one recovery sweep per leadership term, before any placement
+        ssn.cache.fence_sweep_due = False
+        takeover_recovery_sweep(ssn)
     try:
         from volcano_tpu.ops import session_fuse
     except Exception:  # pragma: no cover - jax-free host

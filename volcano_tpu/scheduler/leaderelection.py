@@ -145,11 +145,24 @@ class LeaderElector:
         self._observed_record_key = None
         self._observed_time = 0.0
         self._thread: Optional[threading.Thread] = None
+        # the lease epoch of the most recent acquisition: the record's
+        # leader_transitions + 1, the fencing token every mutating write
+        # of this leadership term carries (store/store.py FencedError).
+        # DELIBERATELY never reset on lost leadership — a deposed
+        # workload's in-flight writes must keep their stale stamp so the
+        # store rejects them, rather than fall back to unfenced.
+        self._epoch = 0
 
     # -- public ------------------------------------------------------------
 
     def is_leader(self) -> bool:
         return self._leading
+
+    def epoch(self) -> int:
+        """The fencing epoch of the most recent acquisition (0 = never
+        led). Valid for writes only while ``is_leader()``; a deposed term
+        keeps its stale epoch by design."""
+        return self._epoch
 
     def start(self) -> None:
         """Run the elector loop on a daemon thread."""
@@ -185,7 +198,14 @@ class LeaderElector:
                 if acquired:
                     last_renew = self._clock()
                     if not self._leading:
-                        logger.info("%s became leader", self.lock.identity)
+                        logger.info("%s became leader (epoch %d)",
+                                    self.lock.identity, self._epoch)
+                        try:
+                            from volcano_tpu.scheduler import metrics
+
+                            metrics.register_leader_transition()
+                        except Exception:  # pragma: no cover
+                            pass
                         # callback BEFORE publishing is_leader(): an observer
                         # that polls is_leader() must find the workload
                         # already started
@@ -278,6 +298,7 @@ class LeaderElector:
                 lease_duration=self.lease_duration,
                 acquire_time=now, renew_time=now)
             if self.lock.create(record):
+                self._epoch = record.leader_transitions + 1
                 self._observe_record(record)
                 return True
             return False  # raced; retry next period
@@ -291,6 +312,7 @@ class LeaderElector:
                 lease_duration=self.lease_duration,
                 acquire_time=now, renew_time=now)
             if self.lock.update(new, version):
+                self._epoch = new.leader_transitions + 1
                 self._observe_record(new)
                 return True
             return False
@@ -310,12 +332,16 @@ class LeaderElector:
                 lease_duration=self.lease_duration,
                 acquire_time=now, renew_time=now,
                 leader_transitions=record.leader_transitions + 1)
-            return self.lock.update(new, version)
+            if self.lock.update(new, version):
+                self._epoch = new.leader_transitions + 1
+                return True
+            return False
 
         # we are the holder: renew
         record.renew_time = now
         record.lease_duration = self.lease_duration
         if self.lock.update(record, version):
+            self._epoch = record.leader_transitions + 1
             return True
         # CAS failure while holding means someone stole an expired lease
         return False

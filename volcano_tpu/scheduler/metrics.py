@@ -133,6 +133,20 @@ class Registry:
             f"{_NAMESPACE}_express_latency_seconds",
             "Express run-once latency in seconds",
             [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25])
+        # HA failover (scheduler/ha.py + store fencing): leadership churn,
+        # the fenced-write rejection total the failover auditor balances
+        # against the store's own accounting, and the degradation-ladder
+        # rung gauge (scheduler/degrade.py) — one labeled series per rung,
+        # 1 while that rung is active
+        self.leader_transitions = Counter(
+            f"{_NAMESPACE}_leader_transitions_total",
+            "Leadership acquisitions observed by this process")
+        self.fenced_writes_rejected = Counter(
+            f"{_NAMESPACE}_fenced_writes_rejected_total",
+            "Writes rejected for carrying a stale lease epoch")
+        self.degraded_mode = Gauge(
+            f"{_NAMESPACE}_degraded_mode",
+            "Degradation-ladder rung activity (1 = active)", ("rung",))
         # instantaneous cluster levels (set each cycle; the sim harness and
         # the scheduler loop both publish through these)
         self.pending_pods = Gauge(
@@ -240,6 +254,18 @@ def observe_express_latency(seconds: float) -> None:
     registry().express_latency.observe(seconds)
 
 
+def register_leader_transition(n: int = 1) -> None:
+    registry().leader_transitions.inc(value=n)
+
+
+def register_fenced_write(n: int = 1) -> None:
+    registry().fenced_writes_rejected.inc(value=n)
+
+
+def set_degraded_mode(rung: str, active: bool) -> None:
+    registry().degraded_mode.set(1.0 if active else 0.0, (rung,))
+
+
 # -- exposition -------------------------------------------------------------
 
 
@@ -268,6 +294,7 @@ def render() -> str:
         r.schedule_attempts, r.preemption_victims, r.preemption_attempts,
         r.unschedule_task_count, r.unschedule_job_count, r.job_retry_counts,
         r.express_placements, r.express_reverted, r.express_deferred,
+        r.leader_transitions, r.fenced_writes_rejected,
     ):
         lines.append(f"# HELP {c.name} {c.help}")
         lines.append(f"# TYPE {c.name} counter")
@@ -276,7 +303,8 @@ def render() -> str:
                 label_str = ",".join(f'{k}="{v2}"' for k, v2 in zip(c.label_names, labels))
                 suffix = f"{{{label_str}}}" if label_str else ""
                 lines.append(f"{c.name}{suffix} {v}")
-    for g in (r.pending_pods, r.queue_depth, r.sessions_run):
+    for g in (r.pending_pods, r.queue_depth, r.sessions_run,
+              r.degraded_mode):
         lines.append(f"# HELP {g.name} {g.help}")
         lines.append(f"# TYPE {g.name} gauge")
         with g._lock:

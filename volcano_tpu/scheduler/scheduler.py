@@ -17,7 +17,7 @@ from typing import List, Optional, Tuple
 
 import yaml
 
-from volcano_tpu.scheduler import conf, metrics
+from volcano_tpu.scheduler import conf, degrade as degrade_mod, metrics
 from volcano_tpu.scheduler import plugins as _plugins  # noqa: F401 (register)
 from volcano_tpu.scheduler import actions as _actions  # noqa: F401 (register)
 from volcano_tpu.scheduler.framework import (
@@ -141,8 +141,20 @@ class Scheduler:
         # inter-cycle wait, and every full session reconciles
         self.express_lane = None
         self._express = express
+        # fault-degradation policy (scheduler/degrade.py): the process
+        # default so the solver's kernel-failure hooks and this loop's
+        # session gate share one ladder; embedders report remote-store
+        # health through it too
+        self.degrade = degrade_mod.default_ladder()
 
     # -- lifecycle ---------------------------------------------------------
+
+    def set_fence_epoch(self, epoch) -> None:
+        """Stamp the effector write-path with the leadership epoch the
+        elector just acquired (scheduler/leaderelection.py epoch();
+        store/store.py FencedError). Call BEFORE run() on each
+        acquisition so no session of the new term writes unfenced."""
+        self.cache.set_fence_epoch(epoch)
 
     def run(self) -> None:
         """Start cache sync then the periodic loop in a background thread
@@ -159,6 +171,10 @@ class Scheduler:
                 logger.exception(
                     "express lane unavailable; arrivals wait for sessions")
                 self._express = False
+        if self.express_lane is not None:
+            # re-acquired leadership (or plain restart): the lane resumes
+            # from wherever the last term parked it
+            self.express_lane.unpark()
         # fresh Event per generation: if stop()'s bounded join left a
         # previous loop thread mid-run_once, that zombie still sees ITS
         # (set) event and exits; clearing a shared event would revive it
@@ -169,6 +185,12 @@ class Scheduler:
         self._thread.start()
 
     def stop(self, stop_cache: bool = True) -> None:
+        if self.express_lane is not None:
+            # failover hygiene: a stopping (possibly deposed) scheduler
+            # must not keep optimistically binding between sessions; the
+            # lane's outstanding tokens survive for the successor's first
+            # session to reconcile
+            self.express_lane.park("scheduler_stopped")
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
@@ -186,9 +208,24 @@ class Scheduler:
         try:
             while not stop.is_set():
                 start = time.perf_counter()
+                if self.degrade.should_skip_session():
+                    # remote-store breaker open (session_skip rung):
+                    # scheduling against an unreachable truth would bind
+                    # on fantasy state — skip, bounded by the ladder's
+                    # staleness budget, until the half-open probe passes
+                    logger.warning(
+                        "session skipped: store circuit open (%s)",
+                        self.degrade.stats()["breakers"]["store"])
+                    self._inter_cycle_wait(stop, self.schedule_period)
+                    continue
                 try:
                     self.run_once()
-                except Exception:
+                    self.degrade.note_store_ok()
+                except Exception as e:
+                    from volcano_tpu.store.remote import RemoteStoreError
+
+                    if isinstance(e, RemoteStoreError):
+                        self.degrade.note_store_error()
                     logger.exception("scheduling cycle failed")
                 policy.maintain()
                 elapsed = time.perf_counter() - start

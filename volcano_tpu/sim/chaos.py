@@ -18,6 +18,16 @@ its own RNG stream, so enabling one never perturbs another's draws:
 - ``kill_session``    — abandon a session between its actions and its
   close (the mirror-flush defer window) and restart the scheduler: the
   crash point where stale-cache accounting bugs historically lived;
+- ``kill_leader``     — HA failover injection (requires the scenario's
+  ``ha.enabled``): depose the active leader at a chosen seam —
+  ``mid_defer`` (between actions and close, the crash the standby's
+  lease expiry resolves), ``mid_chain`` (after N more binds INSIDE a
+  session — mid-fused-chain for rounds sessions), ``mid_express``
+  (after N binds inside an express optimistic commit) — via the real
+  resource-lock CAS, so the store fence revokes the old epoch in the
+  same atomic step that promotes the warm standby. Deterministic
+  ``schedule`` entries pin kills to virtual times; ``rate_per_s`` adds
+  a Poisson stream cycling ``modes``;
 - ``seeded_bug``      — a deliberately reintroduced corruption (the
   auditor's self-test fixture): ``accounting_leak`` re-adds an evicted
   task's request to a node's ``used`` (the evict-without-release bug
@@ -53,6 +63,14 @@ class ChaosInjector:
             rate = float(self.cfg.get(fault, {}).get("rate_per_s", 0.0))
             if rate > 0:
                 self._schedule(fault, rate)
+        kl = self.cfg.get("kill_leader") or {}
+        for entry in kl.get("schedule", []) or []:
+            self.sim.engine.schedule_at(
+                float(entry["at_s"]), "fault-kill_leader",
+                lambda e=dict(entry): self._do_kill_leader(e))
+        rate = float(kl.get("rate_per_s", 0.0))
+        if rate > 0:
+            self._schedule("kill_leader", rate)
         bug = self.cfg.get("seeded_bug")
         if bug:
             self.sim.engine.schedule_at(
@@ -161,6 +179,36 @@ class ChaosInjector:
         self._bump("restart_controllers")
         self.sim.restart_controllers("chaos")
         return "controllers"
+
+    def _do_kill_leader(self, entry: Dict = None) -> str:
+        """Arm an HA depose at the requested seam. The harness fires it at
+        the next opportunity of that mode (the seam itself — a bind hook
+        inside a session's chain, an express commit, or the defer window
+        between a session's actions and its close), so the lease CAS lands
+        exactly where the mode says, not merely "soon"."""
+        sim = self.sim
+        if not getattr(sim, "ha_enabled", False):
+            return "kill_leader: ha disabled"
+        cfg = self.cfg.get("kill_leader") or {}
+        if entry is None:
+            # rate-driven stream: cycle the configured modes in a fixed
+            # order (deterministic — no RNG draw beyond the arrival time)
+            modes = list(cfg.get("modes")
+                         or ["mid_defer", "mid_chain", "mid_express"])
+            fired = self.counts.get("kill_leader", 0)
+            entry = {"mode": modes[fired % len(modes)],
+                     "after_binds": int(cfg.get("after_binds", 1))}
+        if sim._pending_promote:
+            return "kill_leader: takeover already in flight"
+        # a still-armed earlier kill (its seam never materialized — e.g.
+        # a mid_chain arm while sessions had nothing to bind) is REPLACED,
+        # not honored: the newest injection wins, so one starved arm can't
+        # absorb the rest of the schedule
+        mode = str(entry.get("mode", "mid_defer"))
+        after = int(entry.get("after_binds", 1))
+        self._bump("kill_leader")
+        sim.arm_leader_kill(mode, after)
+        return f"armed mode={mode} after_binds={after}"
 
     # -- seeded bugs (auditor self-test) -----------------------------------
 

@@ -44,13 +44,15 @@ from volcano_tpu.scheduler.scheduler import (
     TPU_SCHEDULER_CONF,
     load_scheduler_conf,
 )
+from volcano_tpu.scheduler.leaderelection import (
+    LeaderElectionRecord, ResourceLock)
 from volcano_tpu.sim.auditor import Auditor
 from volcano_tpu.sim.chaos import ChaosInjector
 from volcano_tpu.sim.clock import RngStreams, VirtualClock
 from volcano_tpu.sim.engine import SimEngine
 from volcano_tpu.sim.mirror import JournalMirror
 from volcano_tpu.sim.workload import Workload
-from volcano_tpu.store.store import Store
+from volcano_tpu.store.store import FencedError, Store
 
 _CONF_BY_NAME = {"tpu": TPU_SCHEDULER_CONF, "default": DEFAULT_SCHEDULER_CONF}
 
@@ -60,17 +62,36 @@ class _CountingBinder(DefaultBinder):
     consistency base). Counters live on the sim, so scheduler restarts
     (fresh binder) keep one continuous series. With a clock fn it also
     records each pod's submit->bind wait in VIRTUAL seconds — the
-    latency the storm headline (sessions/sec + p99 task wait) binds on."""
+    latency the storm headline (sessions/sec + p99 task wait) binds on.
+
+    HA probes: ``pre_bind`` (the chaos seam — the harness deposes the
+    leader after N binds to model a kill mid-fused-chain / mid-express-
+    commit), the fenced-rejection tally, and the end-to-end fencing
+    check — a bind that SUCCEEDS while its stamp is older than the
+    store's fence means enforcement broke (counted, audited to zero)."""
 
     def __init__(self, store: Store, counters: Dict[str, int],
-                 now_fn=None, waits: Optional[List[float]] = None):
+                 now_fn=None, waits: Optional[List[float]] = None,
+                 pre_bind=None):
         super().__init__(store)
         self._counters = counters
         self._now = now_fn
         self._waits = waits
+        self._pre_bind = pre_bind
 
     def bind(self, pod, hostname: str) -> None:
-        super().bind(pod, hostname)
+        if self._pre_bind is not None:
+            self._pre_bind()
+        try:
+            super().bind(pod, hostname)
+        except FencedError:
+            self._counters["fenced_binds"] = \
+                self._counters.get("fenced_binds", 0) + 1
+            raise
+        if self.fence_epoch is not None \
+                and self.store.fence_epoch > self.fence_epoch:
+            self._counters["stale_binds_landed"] = \
+                self._counters.get("stale_binds_landed", 0) + 1
         self._counters["binds"] += 1
         if self._now is not None and self._waits is not None:
             created = getattr(pod.metadata, "creation_timestamp", 0.0) or 0.0
@@ -83,7 +104,12 @@ class _CountingEvictor(DefaultEvictor):
         self._counters = counters
 
     def evict(self, pod, reason: str = "") -> None:
-        super().evict(pod, reason)
+        try:
+            super().evict(pod, reason)
+        except FencedError:
+            self._counters["fenced_evicts"] = \
+                self._counters.get("fenced_evicts", 0) + 1
+            raise
         self._counters["evictions"] += 1
 
 
@@ -115,14 +141,43 @@ class SimCluster:
 
         self.store = Store()
         admission.install(self.store, "volcano", gate_pods=True)
-        self.counters: Dict[str, int] = {"binds": 0, "evictions": 0}
+        self.counters: Dict[str, int] = {
+            "binds": 0, "evictions": 0, "fenced_binds": 0,
+            "fenced_evicts": 0, "stale_binds_landed": 0}
         # submit->bind latency per pod, virtual seconds (storm headline);
         # created before the scheduler build, which hands it to the binder
         self._task_wait_s: List[float] = []
         self.express_lane = None
         self._express_ms: List[float] = []
+        # -- HA failover state (cfg["ha"]["enabled"]): a fenced active
+        # leader plus a warm standby cache following the same store; chaos
+        # deposes the leader (mid-defer / mid-chain / mid-express) and the
+        # harness promotes the standby exactly as scheduler/ha.py does,
+        # with every takeover audited for the time/rebuild/compile bounds
+        self.ha_enabled = bool((cfg.get("ha") or {}).get("enabled"))
+        self.leader_epoch = 0
+        self.leader_kills: Dict[str, int] = {}
+        self.takeovers: List[Dict] = []
+        self._all_caches: List = []  # every cache generation (fence balance)
+        self._depose_arm: Optional[Dict] = None
+        self._pending_promote = False
+        self._standby_cache = None
+        self._standby_follows = 0
         self._build_controllers()
         self._build_scheduler()
+        if self.ha_enabled:
+            # the initial lease: epoch 1, written through the REAL
+            # resource-lock path so the store's fence advances exactly as
+            # it does for production electors
+            self._lock = ResourceLock(
+                self.store, "volcano-system", "vc-scheduler", "sim-ha")
+            now = self.vclock.now()
+            self._lock.create(LeaderElectionRecord(
+                holder_identity="sim-leader-e1", lease_duration=15.0,
+                acquire_time=now, renew_time=now))
+            self.leader_epoch = 1
+            self.cache.set_fence_epoch(1)
+            self._standby_cache = self._build_standby_cache()
         self.mirrors = [
             JournalMirror(self.store, kind, cap=int(cfg["mirrors"]["cap"]))
             for kind in cfg["mirrors"]["kinds"]]
@@ -157,18 +212,27 @@ class SimCluster:
         self.gc = GarbageCollector(self.store, clock=self.vclock.now)
         self.kubelet = Kubelet(self.store)
 
+    def _make_cache(self) -> SchedulerCache:
+        cache = SchedulerCache(
+            store=self.store,
+            binder=_CountingBinder(self.store, self.counters,
+                                   now_fn=self.vclock.now,
+                                   waits=self._task_wait_s,
+                                   pre_bind=self._on_bind_attempt),
+            evictor=_CountingEvictor(self.store, self.counters))
+        cache.run()
+        cache.wait_for_cache_sync()
+        self._all_caches.append(cache)
+        return cache
+
     def _build_scheduler(self) -> None:
         conf_ref = self.cfg["scheduler"]["conf"]
         conf_str = _CONF_BY_NAME.get(conf_ref, conf_ref)
         self.actions, self.tiers = load_scheduler_conf(conf_str)
-        self.cache = SchedulerCache(
-            store=self.store,
-            binder=_CountingBinder(self.store, self.counters,
-                                   now_fn=self.vclock.now,
-                                   waits=self._task_wait_s),
-            evictor=_CountingEvictor(self.store, self.counters))
-        self.cache.run()
-        self.cache.wait_for_cache_sync()
+        self.cache = self._make_cache()
+        if self.ha_enabled and self.leader_epoch:
+            # a restarted (same-term) leader keeps its current epoch
+            self.cache.set_fence_epoch(self.leader_epoch)
         if (self.cfg.get("express") or {}).get("enabled"):
             # one lane for the sim's lifetime, re-attached across
             # scheduler restarts: tokens survive a crash (the binds are
@@ -199,6 +263,146 @@ class SimCluster:
         self.restarts["controllers"] += 1
         self.engine.log_event("restart-controllers", why)
 
+    # -- HA failover: warm standby, depose, promote -------------------------
+
+    def _build_standby_cache(self) -> SchedulerCache:
+        """A second cache following the same store (the warm standby's
+        substrate): synchronous watches keep it mirrored; the periodic
+        standby slice keeps its SnapshotKeeper/node-axis warm so takeover
+        opens incrementally (scheduler/ha.py WarmStandby, deterministic)."""
+        return self._make_cache()
+
+    def _standby_slice(self) -> str:
+        cache = self._standby_cache
+        if cache is None:
+            return "no-standby"
+        cache.snapshot()
+        self._standby_follows += 1
+        stats = cache.snap_keeper.stats
+        self._schedule_standby()
+        return (f"follows={self._standby_follows} "
+                f"rebuilds={stats['rebuilds']} "
+                f"incremental={stats['incremental']}")
+
+    def _schedule_standby(self) -> None:
+        period = float((self.cfg.get("ha") or {}).get(
+            "follow_period_s", self.cfg["scheduler"]["period_s"]))
+        at = self.vclock.now() + period
+        if at <= self._horizon + 1e-9:
+            self.engine.schedule_at(at, "standby-follow", self._standby_slice)
+
+    def arm_leader_kill(self, mode: str, after_binds: int = 0) -> None:
+        """Chaos seam: depose the leader at the next opportunity of the
+        given mode — ``mid_defer`` (between a session's actions and its
+        close), ``mid_chain`` (after ``after_binds`` more binds inside a
+        session — mid-fused-chain for rounds sessions), ``mid_express``
+        (after ``after_binds`` binds inside an express commit)."""
+        if mode == "mid_express" and self.express_lane is None:
+            mode = "mid_defer"  # no lane to kill inside; nearest seam
+        self._depose_arm = {"mode": mode, "countdown": int(after_binds),
+                            "live": False}
+
+    def _on_bind_attempt(self) -> None:
+        """Counting-binder pre-bind hook: an armed in-phase depose fires
+        here, so the CAS takeover lands BETWEEN two binds of one batch —
+        the very next store write of the old term is fenced."""
+        arm = self._depose_arm
+        if arm is None or not arm["live"]:
+            return
+        if arm["countdown"] > 0:
+            arm["countdown"] -= 1
+            return
+        self._depose_leader(arm["mode"])
+
+    def _depose_leader(self, why: str) -> None:
+        """The standby CASes the lock exactly as a real elector takeover
+        does (leaderelection._try_acquire_or_renew expired path): the
+        lease write advances the store fence atomically, revoking the old
+        epoch's write authority in the same step that grants the new."""
+        got = self._lock.get()
+        record, version = got
+        transitions = (record.leader_transitions + 1
+                       if record is not None else self.leader_epoch)
+        now = self.vclock.now()
+        if not self._lock.update(LeaderElectionRecord(
+                holder_identity=f"sim-leader-e{transitions + 1}",
+                lease_duration=15.0, acquire_time=now, renew_time=now,
+                leader_transitions=transitions), version):
+            raise RuntimeError("sim lease CAS lost — single-writer sim "
+                               "should never race")
+        self.leader_epoch = transitions + 1
+        metrics.register_leader_transition()
+        self.leader_kills[why] = self.leader_kills.get(why, 0) + 1
+        self._depose_arm = None
+        self._pending_promote = True
+        self.engine.log_event(
+            "leader-depose", f"mode={why} epoch={self.leader_epoch}")
+
+    def _complete_promote(self) -> None:
+        """Finish the failover at the end of the deposed slice: the old
+        cache detaches (the dead process analog), the warm standby
+        becomes active under the new epoch, the express lane re-attaches
+        and unparks (its outstanding tokens drain through the new
+        leader's first session), and a replacement standby starts
+        following."""
+        self._pending_promote = False
+        old = self.cache
+        old.detach_watches()
+        self.cache = self._standby_cache
+        self.cache.set_fence_epoch(self.leader_epoch)
+        keeper = self.cache.snap_keeper
+        takeover = {
+            "epoch": self.leader_epoch,
+            "at": self.vclock.now(),
+            "standby_follows": self._standby_follows,
+            "rebuilds0": keeper.stats["rebuilds"],
+            "first_session_at": None,
+            "first_session_compiles": None,
+            "rebuilds_delta": None,
+            "undrained_tokens": None,
+            "tokens_at_takeover": [],
+            "seq_at_takeover": 0,
+        }
+        if self.express_lane is not None:
+            lane = self.express_lane
+            takeover["tokens_at_takeover"] = sorted(lane.outstanding)
+            takeover["seq_at_takeover"] = lane.session_seq
+            lane.attach(self.cache)
+            lane.unpark()
+        self.takeovers.append(takeover)
+        self._standby_cache = self._build_standby_cache()
+        self._standby_follows = 0
+        self.engine.log_event(
+            "leader-takeover",
+            f"epoch={self.leader_epoch} "
+            f"tokens={len(takeover['tokens_at_takeover'])}")
+
+    def _note_first_led_session(self, killed: bool) -> None:
+        """Record the first completed session of the newest term — the
+        auditor's takeover-bound probe (<= 2 cycle periods, zero
+        wholesale rebuilds, zero compiles, tokens drained)."""
+        if killed or not self.takeovers:
+            return
+        takeover = self.takeovers[-1]
+        if takeover["first_session_at"] is not None:
+            return
+        takeover["first_session_at"] = self.vclock.now()
+        takeover["first_session_compiles"] = self._session_compiles[-1]
+        takeover["rebuilds_delta"] = (
+            self.cache.snap_keeper.stats["rebuilds"] - takeover["rebuilds0"])
+        lane = self.express_lane
+        if lane is not None:
+            takeover["undrained_tokens"] = [
+                uid for uid in takeover["tokens_at_takeover"]
+                if uid in lane.outstanding
+                and lane.outstanding[uid].seq <= takeover["seq_at_takeover"]]
+        else:
+            takeover["undrained_tokens"] = []
+
+    def all_caches(self) -> List[SchedulerCache]:
+        """Every cache generation this run created (fencing balance)."""
+        return list(self._all_caches)
+
     # -- the session slice -------------------------------------------------
 
     # process_all's default 10k-iteration runaway guard underestimates a
@@ -217,13 +421,35 @@ class SimCluster:
         self._controllers_step()
 
         kill = self.chaos.should_kill_session()
+        arm = self._depose_arm
+        if arm is not None and arm["mode"] == "mid_chain":
+            # the bind hook deposes the leader after `countdown` more
+            # binds — inside this session's fused chain / bulk writeback
+            arm["live"] = True
         win = self._watcher.window() if self._watcher is not None else None
         t0 = time.perf_counter()
         ssn = open_session(self.cache, self.tiers)
         t1 = time.perf_counter()
-        # fused whole-session dispatch when the session qualifies
-        run_actions(ssn, self.actions)
+        try:
+            # fused whole-session dispatch when the session qualifies
+            run_actions(ssn, self.actions)
+        except Exception:
+            if not self._pending_promote:
+                raise
+            # a mid-chain depose aborted a serial effector path: the
+            # fence already protected the store; the deposed session is
+            # abandoned exactly like a crash
         t2 = time.perf_counter()
+        if arm is not None:
+            arm["live"] = False
+        deposed_mid_defer = False
+        if (arm is not None and arm["mode"] == "mid_defer"
+                and not self._pending_promote):
+            # the kill lands INSIDE the defer window: actions ran (binds
+            # hit the store) but the close never will — and the standby's
+            # lease CAS revokes the dead term's write authority first
+            self._depose_leader("mid_defer")
+            deposed_mid_defer = True
         if kill:
             # crash inside the defer window: actions ran (binds hit the
             # store) but the close-time mirror flush / status writeback
@@ -231,8 +457,19 @@ class SimCluster:
             self.session_kills += 1
             self.restart_scheduler("session-kill")
             t3 = t2
+        elif deposed_mid_defer:
+            self.session_kills += 1
+            t3 = t2
         else:
-            close_session(ssn)
+            try:
+                close_session(ssn)
+            except Exception:
+                # a deposed-but-alive leader's close: fenced status
+                # writebacks degrade to accounting (status updater), but
+                # any residual path failing must not crash the sim — the
+                # term is over either way
+                if not self._pending_promote:
+                    raise
             t3 = time.perf_counter()
         self._open_ms.append((t1 - t0) * 1e3)
         self._actions_ms.append((t2 - t1) * 1e3)
@@ -242,6 +479,10 @@ class SimCluster:
             win.delta().compiles if win is not None else 0)
         self.sessions_done += 1
         metrics.set_sessions_run(self.sessions_done)
+        if self._pending_promote:
+            self._complete_promote()
+        else:
+            self._note_first_led_session(killed=kill)
 
         # post-session convergence (Cluster.step order)
         self.job_controller.process_all(max_iterations=self._CONTROLLER_BUDGET)
@@ -306,10 +547,20 @@ class SimCluster:
         the continuously-running controllers would have), then drain the
         lane's arrival queue. The logged line carries only deterministic
         counts — wall latency goes to the summary, never the hashed log."""
+        arm = self._depose_arm
+        if arm is not None and arm["mode"] == "mid_express":
+            # depose fires inside this batch's optimistic commit: the
+            # fenced bind parks the lane and the partial token drains
+            # through the new leader's first session
+            arm["live"] = True
         self._controllers_step()
         t0 = time.perf_counter()
         rep = self.express_lane.run_once()
         self._express_ms.append((time.perf_counter() - t0) * 1e3)
+        if arm is not None:
+            arm["live"] = False
+        if self._pending_promote:
+            self._complete_promote()
         self._schedule_express()
         return (f"queued={rep['queued']} placed={rep['placed']} "
                 f"deferred={rep['deferred']}")
@@ -327,9 +578,12 @@ class SimCluster:
         from volcano_tpu.scheduler.util import scheduler_helper
         from volcano_tpu.utils import clock as uclock
 
+        from volcano_tpu.scheduler import degrade
+
         self._horizon = float(duration if duration is not None
                               else self.cfg["duration_s"])
         metrics.reset()
+        degrade.reset()
         scheduler_helper.reset_round_robin()
         uclock.set_source(self.vclock.timestamp)
         pkg_logger = logging.getLogger("volcano_tpu")
@@ -349,6 +603,8 @@ class SimCluster:
             self._schedule_slice()
             if self.express_lane is not None:
                 self._schedule_express()
+            if self.ha_enabled:
+                self._schedule_standby()
             self.engine.run_until(self._horizon)
             self.engine.log_event(
                 "end",
@@ -395,7 +651,8 @@ class SimCluster:
                 m.kind: {"resets": m.resets,
                          "synthesized_deletes": m.synthesized_deletes,
                          "skipped_drains": m.skipped_drains,
-                         "dropped_polls": m.dropped_polls}
+                         "dropped_polls": m.dropped_polls,
+                         "journal_squashed": m.journal.squashed}
                 for m in self.mirrors},
             "audit": {
                 "checks": self.auditor.checks_run,
@@ -419,4 +676,22 @@ class SimCluster:
                 "state": dict(self.express_lane.state.stats)
                 if self.express_lane.state else {},
             } if self.express_lane is not None else None),
+            "ha": ({
+                "epoch": self.leader_epoch,
+                "leader_kills": dict(sorted(self.leader_kills.items())),
+                "standby_follows": self._standby_follows,
+                "fence": {
+                    "epoch": self.store.fence_stats["epoch"],
+                    "advances": self.store.fence_stats["advances"],
+                    "rejected": self.store.fence_stats["rejected"],
+                    "rejected_by_kind": dict(sorted(
+                        self.store.fence_stats["rejected_by_kind"].items())),
+                    "observed_by_effectors": sum(
+                        c.fenced_rejections() for c in self._all_caches),
+                },
+                "takeovers": [
+                    {k: v for k, v in t.items()
+                     if k not in ("tokens_at_takeover",)}
+                    for t in self.takeovers],
+            } if self.ha_enabled else None),
         }

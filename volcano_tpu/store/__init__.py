@@ -5,6 +5,8 @@ versioning, watch streams, admission middleware, and an event recorder."""
 from volcano_tpu.store.store import (
     AdmissionError,
     ConflictError,
+    FencedError,
+    FencedStoreView,
     NotFoundError,
     Store,
     WatchHandler,

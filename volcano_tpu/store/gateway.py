@@ -61,13 +61,30 @@ class _WatchJournal:
     Seeded with ADDED entries for existing objects at creation (the
     list+watch initial sync), so a client polling from since=0 sees the
     full state. Trimmed at ``cap``; a reader whose cursor predates the
-    ring start gets reset=True and must re-list."""
+    ring start gets reset=True and must re-list.
+
+    Backpressure coalescing: while every watcher is behind a MODIFIED for
+    key K (no poll has served K's latest MODIFIED yet), a newer MODIFIED
+    for K squashes into it in place — the entry keeps its original "old"
+    and takes the newest "object", so a catching-up client observes one
+    old->newest transition instead of the whole chain. Under fan-out with
+    slow watchers this is what keeps a MODIFIED storm (no-op update
+    bursts, status churn) from rolling the ring past every cursor and
+    forcing spurious 410-style reset/re-list cycles. Squashing is gated
+    on ``_served_to`` (the highest sequence any poll has handed out):
+    an entry some client may already have consumed is immutable, so no
+    client can ever miss a final state."""
 
     def __init__(self, store: Store, kind: str, cap: int = 4096):
         self.cond = threading.Condition()
         self.events: list = []
         self.start = 0  # sequence number of events[0]
         self.cap = cap
+        self.squashed = 0  # MODIFIED events coalesced away
+        self._served_to = 0  # highest seq ever returned by a poll
+        # key -> (seq, type) of that key's latest ring entry, the squash
+        # candidate index; pruned lazily against the ring start
+        self._latest: dict = {}
         store.watch(kind, WatchHandler(
             added=lambda new: self._append("ADDED", None, new),
             updated=lambda old, new: self._append("MODIFIED", old, new),
@@ -75,17 +92,37 @@ class _WatchJournal:
         ), replay=True)
 
     def _append(self, etype: str, old, new) -> None:
-        entry = {"type": etype}
+        from volcano_tpu.store.store import object_key
+
+        key = object_key(new if new is not None else old)
+        entry = {"type": etype, "key": key}
         if new is not None:
             entry["object"] = codec.envelope(new)
         if old is not None:
             entry["old"] = codec.envelope(old)
         with self.cond:
+            if etype == "MODIFIED":
+                prior = self._latest.get(key)
+                if prior is not None:
+                    seq, ptype = prior
+                    if ptype == "MODIFIED" and seq >= self.start \
+                            and seq >= self._served_to:
+                        # unserved chain tail for this key: squash in
+                        # place (keep the chain's original "old")
+                        merged = self.events[seq - self.start]
+                        merged["object"] = entry["object"]
+                        self.squashed += 1
+                        self.cond.notify_all()
+                        return
             self.events.append(entry)
+            self._latest[key] = (self.start + len(self.events) - 1, etype)
             if len(self.events) > self.cap:
                 drop = len(self.events) - self.cap
                 del self.events[:drop]
                 self.start += drop
+            if len(self._latest) > 4 * self.cap:
+                self._latest = {k: v for k, v in self._latest.items()
+                                if v[0] >= self.start}
             self.cond.notify_all()
 
     def poll(self, since: int, timeout: float):
@@ -96,7 +133,13 @@ class _WatchJournal:
             while True:
                 end = self.start + len(self.events)
                 if since < self.start:
-                    return [], end, True  # fell behind the ring: re-list
+                    # fell behind the ring: re-list. The reset ALSO ends
+                    # squash eligibility through `end`: the client resumes
+                    # from `end`, so a post-reset MODIFIED squashed into an
+                    # entry below it would vanish into the gap between this
+                    # reset and the client's re-list — a lost final state.
+                    self._served_to = max(self._served_to, end)
+                    return [], end, True
                 if since > end:
                     # cursor from a FUTURE sequence this journal never
                     # assigned (a client that outlived a gateway restart,
@@ -104,9 +147,12 @@ class _WatchJournal:
                     # catch up would silently skip every event in the gap
                     # — the same phantom-object hazard as falling behind —
                     # so signal the HTTP-410-style reset and make the
-                    # client re-list.
+                    # client re-list (and freeze squashes, as above).
+                    self._served_to = max(self._served_to, end)
                     return [], end, True
                 if since < end:
+                    # entries handed out become immutable (the squash gate)
+                    self._served_to = max(self._served_to, end)
                     return list(self.events[since - self.start:]), end, False
                 if deadline is None:
                     import time as _time
@@ -268,8 +314,15 @@ class ApiGateway:
                     logger.exception("gateway GET %s failed", self.path)
                     self._error(500, e)
 
+            def _epoch(self, q):
+                """Optional lease-epoch stamp on a mutating verb (the
+                fencing-token hop for remote leaders; store/store.py)."""
+                if "epoch" not in q:
+                    return None
+                return int(q["epoch"])
+
             def do_POST(self):  # noqa: N802
-                segs, _ = self._route()
+                segs, q = self._route()
                 if not self._authorized(segs):
                     return
                 try:
@@ -299,7 +352,7 @@ class ApiGateway:
                                          f" != {segs[1]}",
                                 "type": "ValueError"})
                             return
-                        created = store.create(obj)
+                        created = store.create(obj, epoch=self._epoch(q))
                         self._reply(201, codec.envelope(created))
                     else:
                         self._reply(404, {"error": "not found"})
@@ -338,7 +391,8 @@ class ApiGateway:
                             return
                         expect = (int(q["expect"])
                                   if "expect" in q else None)
-                        updated = store.update(obj, expect_version=expect)
+                        updated = store.update(obj, expect_version=expect,
+                                               epoch=self._epoch(q))
                         self._reply(200, codec.envelope(updated))
                     else:
                         self._reply(404, {"error": "not found"})
@@ -353,18 +407,23 @@ class ApiGateway:
                     self._error(500, e)
 
             def do_DELETE(self):  # noqa: N802
-                segs, _ = self._route()
+                segs, q = self._route()
                 if not self._authorized(segs):
                     return
                 try:
                     if len(segs) == 4 and segs[0] == "apis":
                         ns = "" if segs[2] == "-" else segs[2]
-                        obj = store.delete(segs[1], ns, segs[3])
+                        obj = store.delete(segs[1], ns, segs[3],
+                                           epoch=self._epoch(q))
                         self._reply(200, codec.envelope(obj))
                     else:
                         self._reply(404, {"error": "not found"})
                 except NotFoundError as e:
                     self._error(404, e)
+                except ConflictError as e:
+                    self._error(409, e)  # fenced delete (stale lease epoch)
+                except ValueError as e:
+                    self._error(400, e)  # malformed epoch=
                 except Exception as e:  # noqa: BLE001
                     logger.exception("gateway DELETE %s failed", self.path)
                     self._error(500, e)

@@ -30,8 +30,8 @@ from typing import Dict, List, Optional
 
 from volcano_tpu.api import codec
 from volcano_tpu.store.store import (
-    CLUSTER_SCOPED, AdmissionError, ConflictError, NotFoundError,
-    WatchHandler)
+    CLUSTER_SCOPED, AdmissionError, ConflictError, FencedError,
+    NotFoundError, WatchHandler)
 
 logger = logging.getLogger(__name__)
 
@@ -73,6 +73,13 @@ class RemoteStore:
             self._ssl_ctx.verify_mode = ssl.CERT_NONE
         self._watch_stop = threading.Event()
         self._watch_threads: List[threading.Thread] = []
+        # watch-path retry diagnostics (snap_keeper_stats-style): polls /
+        # resets / retry counts and the total seconds spent backing off,
+        # shared across the per-kind poll threads under _watch_stats_lock
+        self._watch_stats_lock = threading.Lock()
+        self._watch_stats: Dict[str, float] = {
+            "polls": 0, "poll_errors": 0, "resets": 0,
+            "relist_retries": 0, "backoff_s": 0.0, "max_backoff_s": 0.0}
         self._event_buf: List[dict] = []
         self._event_lock = threading.Lock()
         self._event_wake = threading.Event()
@@ -111,6 +118,11 @@ class RemoteStore:
             if e.code == 404:
                 raise NotFoundError(msg) from None
             if e.code == 409:
+                # the fenced-write subtype survives the HTTP hop: a remote
+                # deposed leader must see the same exception the in-process
+                # effectors do, or its rewind paths would misclassify
+                if detail.get("type") == "FencedError":
+                    raise FencedError(msg) from None
                 raise ConflictError(msg) from None
             if e.code == 422:
                 raise AdmissionError(msg) from None
@@ -128,27 +140,36 @@ class RemoteStore:
 
     # -- verbs (Store surface subset) ---------------------------------------
 
-    def create(self, obj) -> object:
+    def create(self, obj, epoch: Optional[int] = None) -> object:
         kind = type(obj).KIND
-        out = self._request("POST", f"/apis/{kind}", codec.envelope(obj))
+        q = {"epoch": str(epoch)} if epoch is not None else None
+        out = self._request("POST", f"/apis/{kind}", codec.envelope(obj), q)
         return codec.from_envelope(out)
 
-    def update(self, obj, expect_version: Optional[int] = None) -> object:
+    def update(self, obj, expect_version: Optional[int] = None,
+               epoch: Optional[int] = None) -> object:
         kind = type(obj).KIND
         ns = self._ns_seg(
             "" if kind in CLUSTER_SCOPED else obj.metadata.namespace)
-        q = {"expect": str(expect_version)} if expect_version is not None else None
+        q: Dict[str, str] = {}
+        if expect_version is not None:
+            q["expect"] = str(expect_version)
+        if epoch is not None:
+            q["epoch"] = str(epoch)
         out = self._request(
             "PUT", f"/apis/{kind}/{ns}/{obj.metadata.name}",
-            codec.envelope(obj), q)
+            codec.envelope(obj), q or None)
         return codec.from_envelope(out)
 
-    def update_status(self, obj) -> object:
-        return self.update(obj)
+    def update_status(self, obj, epoch: Optional[int] = None) -> object:
+        return self.update(obj, epoch=epoch)
 
-    def delete(self, kind: str, namespace: str, name: str) -> object:
+    def delete(self, kind: str, namespace: str, name: str,
+               epoch: Optional[int] = None) -> object:
+        q = {"epoch": str(epoch)} if epoch is not None else None
         out = self._request(
-            "DELETE", f"/apis/{kind}/{self._ns_seg(namespace)}/{name}")
+            "DELETE", f"/apis/{kind}/{self._ns_seg(namespace)}/{name}",
+            query=q)
         return codec.from_envelope(out)
 
     def try_delete(self, kind: str, namespace: str, name: str):
@@ -186,6 +207,21 @@ class RemoteStore:
             "GET", f"/events/{kind}/{ns}/{obj.metadata.name}")
         return [RemoteEvent(i["event_type"], i["reason"], i["message"])
                 for i in out.get("items", [])]
+
+    def watch_stats(self) -> Dict[str, float]:
+        """Watch-path retry/backoff counters (diagnostics surface)."""
+        with self._watch_stats_lock:
+            out = dict(self._watch_stats)
+        out["backoff_s"] = round(out["backoff_s"], 3)
+        out["max_backoff_s"] = round(out["max_backoff_s"], 3)
+        return out
+
+    def _bump_watch_stat(self, key: str, value: float = 1) -> None:
+        with self._watch_stats_lock:
+            self._watch_stats[key] += value
+            if key == "backoff_s":
+                self._watch_stats["max_backoff_s"] = max(
+                    self._watch_stats["max_backoff_s"], value)
 
     def healthy(self, timeout: Optional[float] = None) -> bool:
         """Gateway liveness. ``timeout`` overrides the store default —
@@ -326,11 +362,17 @@ class RemoteStore:
         current objects as ADDED — at-least-once; handlers must be
         idempotent on re-ADDs, which the store-backed caches/controllers
         are. A FAILED re-list retries without advancing the cursor (the
-        next poll resets again), so the gap is never silently skipped.
+        next poll resets again), so the gap is never silently skipped —
+        and both poll and re-list retries run under capped jittered
+        exponential backoff (scheduler/degrade.Backoff), never
+        fixed-interval hammering: a gateway restarting under thousands of
+        watchers must see de-correlated retries, not a synchronized herd.
+        Retry/backoff tallies surface through ``watch_stats()``.
 
         Callbacks run on the poll thread — the same "handler runs on a
         foreign thread" contract as the in-process store, whose handlers
         run on the writer's thread."""
+        from volcano_tpu.scheduler.degrade import Backoff
         from volcano_tpu.store.store import object_key
 
         since = 0
@@ -343,6 +385,13 @@ class RemoteStore:
         # the attribute, so a still-draining old poller must keep seeing
         # its own (set) event rather than resurrecting on the fresh one
         stop = self._watch_stop
+        poll_backoff = Backoff(f"watch-poll:{kind}", base=0.25, cap=15.0)
+        relist_backoff = Backoff(f"watch-relist:{kind}", base=0.25, cap=15.0)
+
+        def _pause(backoff: Backoff) -> None:
+            delay = backoff.next_delay()
+            self._bump_watch_stat("backoff_s", delay)
+            stop.wait(delay)
 
         def _loop(since=since):
             # last-delivered object per key — the reset path's diff base
@@ -354,23 +403,30 @@ class RemoteStore:
                         query={"since": str(since),
                                "timeout": str(poll_timeout)},
                         timeout=poll_timeout + self.timeout)
+                    self._bump_watch_stat("polls")
+                    poll_backoff.reset()
                 except Exception as e:
                     if stop.is_set():
                         return
-                    logger.warning("watch %s poll failed (%s); retrying", kind, e)
-                    stop.wait(1.0)
+                    self._bump_watch_stat("poll_errors")
+                    logger.warning("watch %s poll failed (%s); retrying "
+                                   "in ~%.2fs", kind, e, poll_backoff.peek())
+                    _pause(poll_backoff)
                     continue
                 if out.get("reset"):
+                    self._bump_watch_stat("resets")
                     try:
                         listed = {object_key(o): o for o in self.list(kind)}
+                        relist_backoff.reset()
                     except Exception as e:
                         # do NOT advance `since`: the next poll returns
                         # reset again and the re-list is retried, instead
                         # of permanently skipping the journal gap
+                        self._bump_watch_stat("relist_retries")
                         logger.warning(
-                            "watch %s re-list failed (%s); retrying",
-                            kind, e)
-                        stop.wait(1.0)
+                            "watch %s re-list failed (%s); retrying "
+                            "in ~%.2fs", kind, e, relist_backoff.peek())
+                        _pause(relist_backoff)
                         continue
                     since = int(out.get("next", 0))
                     for key in [k for k in known if k not in listed]:

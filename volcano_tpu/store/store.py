@@ -22,6 +22,7 @@ convention). The scheduler cache clones what it needs into its snapshot.
 
 from __future__ import annotations
 
+import json
 import threading
 from dataclasses import dataclass, field
 from itertools import repeat
@@ -39,12 +40,30 @@ class ConflictError(RuntimeError):
     pass
 
 
+class FencedError(ConflictError):
+    """A write stamped with a lease epoch older than the store's fence.
+
+    The fencing-token half of leader election (scheduler/leaderelection.py):
+    every mutating write a leader performs carries its lease epoch, and the
+    store rejects epochs older than the newest lease it has seen — so a
+    deposed leader finishing an in-flight fused chain or express commit
+    cannot double-bind against the new leader's placements. Subclassing
+    ConflictError keeps every existing 409/conflict handler correct."""
+
+
 class AdmissionError(ValueError):
     """An admission validator rejected the request."""
 
 
 # Kinds without a namespace (keyed by bare name).
 CLUSTER_SCOPED = {"Node", "Queue", "PriorityClass", "PersistentVolume"}
+
+# The resource-lock record annotation (scheduler/leaderelection.py). The
+# store recognizes lease writes by this key and advances its fence epoch
+# from the record's transition count — fencing authority lives SERVER-side,
+# so a remote elector CASing the lock through the gateway revokes the old
+# leader's write authority in the same atomic step that grants its own.
+LEADER_RECORD_ANNOTATION = "control-plane.alpha.volcano/leader"
 
 
 def object_key(obj) -> str:
@@ -109,8 +128,81 @@ class Store:
         self._mutators: Dict[str, List[Callable]] = {}
         self._validators: Dict[str, List[Callable]] = {}
         self._resource_version = 0
+        # lease-epoch fence: the newest leadership epoch this store has
+        # seen (0 = no lease ever written — fencing disarmed until a
+        # leader exists). Writes stamped with an older epoch are rejected
+        # with FencedError and accounted here, per kind and per stale
+        # epoch, so the failover auditor can balance every rejection
+        # against the component that observed it.
+        self._fence_epoch = 0
+        self.fence_stats: Dict[str, object] = {
+            "epoch": 0, "advances": 0, "rejected": 0,
+            "rejected_by_kind": {}, "rejected_by_epoch": {}}
         # RecordedEvent | ScheduledEvent (duck-typed event contract)
         self.events: list = []
+
+    # -- lease-epoch fencing -----------------------------------------------
+
+    @property
+    def fence_epoch(self) -> int:
+        with self._lock:
+            return self._fence_epoch
+
+    def advance_fence(self, epoch: int) -> None:
+        """Raise the fence to ``epoch`` (never lowers). Normally implicit —
+        lease ConfigMap writes advance it — but exposed for tests and for
+        embedders with out-of-band election."""
+        with self._lock:
+            if epoch > self._fence_epoch:
+                self._fence_epoch = int(epoch)
+                self.fence_stats["epoch"] = self._fence_epoch
+                self.fence_stats["advances"] += 1
+
+    def _check_fence(self, kind: str, key: str,
+                     epoch: Optional[int]) -> None:
+        """Reject a write whose stamp predates the current fence (caller
+        holds the lock). Unstamped writes (epoch None) pass — controllers,
+        kubelets, and tests carry their own authority."""
+        if epoch is None or epoch >= self._fence_epoch:
+            return
+        self.fence_stats["rejected"] += 1
+        by_kind = self.fence_stats["rejected_by_kind"]
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+        by_epoch = self.fence_stats["rejected_by_epoch"]
+        by_epoch[int(epoch)] = by_epoch.get(int(epoch), 0) + 1
+        # observability import stays lazy: the store is the substrate and
+        # must not pull the scheduler package in at import time
+        from volcano_tpu.scheduler import metrics as _metrics
+
+        _metrics.register_fenced_write()
+        raise FencedError(
+            f"{kind} {key}: write fenced: lease epoch {epoch} < "
+            f"current epoch {self._fence_epoch}")
+
+    def _maybe_advance_fence(self, obj, kind: str) -> None:
+        """A lease-record ConfigMap write with a non-empty holder carries
+        the new leadership epoch (leader_transitions + 1); advance the
+        fence so older-epoch writers are rejected from this instant
+        (caller holds the lock — revoke and grant are one atomic step)."""
+        if kind != "ConfigMap":
+            return
+        raw = (obj.metadata.annotations or {}).get(LEADER_RECORD_ANNOTATION)
+        if not raw:
+            return
+        try:
+            record = json.loads(raw)
+        except (ValueError, TypeError):
+            return
+        if not record.get("holder_identity"):
+            return  # a clean release keeps the current epoch in force
+        try:
+            epoch = int(record.get("leader_transitions", 0)) + 1
+        except (ValueError, TypeError):
+            return
+        if epoch > self._fence_epoch:
+            self._fence_epoch = epoch
+            self.fence_stats["epoch"] = epoch
+            self.fence_stats["advances"] += 1
 
     # -- admission ---------------------------------------------------------
 
@@ -131,7 +223,7 @@ class Store:
 
     # -- writes ------------------------------------------------------------
 
-    def create(self, obj) -> object:
+    def create(self, obj, epoch: Optional[int] = None) -> object:
         kind = type(obj).KIND
         with self._lock:
             for mutate in self._mutators.get(kind, []):
@@ -141,24 +233,31 @@ class Store:
 
             obj.metadata.ensure_identity()
             key = object_key(obj)
+            self._check_fence(kind, key, epoch)
             bucket = self._buckets.setdefault(kind, {})
             if key in bucket:
                 raise ConflictError(f"{kind} {key} already exists")
             self._resource_version += 1
             obj.metadata.resource_version = self._resource_version
             bucket[key] = obj
+            self._maybe_advance_fence(obj, kind)
             self._dispatch(kind, "ADDED", None, obj)
             return obj
 
-    def update(self, obj, expect_version: Optional[int] = None) -> object:
+    def update(self, obj, expect_version: Optional[int] = None,
+               epoch: Optional[int] = None) -> object:
         """Replace an object. With ``expect_version`` the write is a
         compare-and-swap: it fails with ConflictError unless the stored
         object's resource_version still matches — the optimistic-concurrency
         primitive the k8s API server provides and the reference's
-        resource-lock leader election depends on."""
+        resource-lock leader election depends on. With ``epoch`` the write
+        is additionally fenced: a stamp older than the store's current
+        lease epoch raises FencedError (split-brain protection for a
+        deposed leader's in-flight writes)."""
         kind = type(obj).KIND
         with self._lock:
             key = object_key(obj)
+            self._check_fence(kind, key, epoch)
             bucket = self._buckets.setdefault(kind, {})
             old = bucket.get(key)
             if old is None:
@@ -171,16 +270,19 @@ class Store:
             self._resource_version += 1
             obj.metadata.resource_version = self._resource_version
             bucket[key] = obj
+            self._maybe_advance_fence(obj, kind)
             self._dispatch(kind, "MODIFIED", old, obj)
             return obj
 
-    def update_status(self, obj) -> object:
+    def update_status(self, obj, epoch: Optional[int] = None) -> object:
         """Alias of update — status subresource writes share the path."""
-        return self.update(obj)
+        return self.update(obj, epoch=epoch)
 
-    def delete(self, kind: str, namespace: str, name: str) -> object:
+    def delete(self, kind: str, namespace: str, name: str,
+               epoch: Optional[int] = None) -> object:
         with self._lock:
             key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
+            self._check_fence(kind, key, epoch)
             bucket = self._buckets.get(kind, {})
             obj = bucket.pop(key, None)
             if obj is None:
@@ -317,3 +419,44 @@ class Store:
         kind = type(obj).KIND
         with self._lock:
             return [e for e in self.events if e.object_kind == kind and e.object_key == key]
+
+
+class FencedStoreView:
+    """A Store (or RemoteStore) facade whose mutating verbs carry a lease
+    epoch read at call time.
+
+    Components with many write sites (the controller manager, a kubelet)
+    get failover fencing by construction instead of threading ``epoch=``
+    through every call: build them over a FencedStoreView whose
+    ``epoch_source`` is the elector's current epoch. Reads, watches, and
+    event recording pass through unchanged (events are observability, and
+    watches carry no authority)."""
+
+    _STAMPED = {"create", "update", "update_status", "delete"}
+
+    def __init__(self, store, epoch_source: Callable[[], Optional[int]]):
+        self._store = store
+        self._epoch_source = epoch_source
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+    def create(self, obj) -> object:
+        return self._store.create(obj, epoch=self._epoch_source())
+
+    def update(self, obj, expect_version: Optional[int] = None) -> object:
+        return self._store.update(obj, expect_version=expect_version,
+                                  epoch=self._epoch_source())
+
+    def update_status(self, obj) -> object:
+        return self._store.update_status(obj, epoch=self._epoch_source())
+
+    def delete(self, kind: str, namespace: str, name: str) -> object:
+        return self._store.delete(kind, namespace, name,
+                                  epoch=self._epoch_source())
+
+    def try_delete(self, kind: str, namespace: str, name: str):
+        try:
+            return self.delete(kind, namespace, name)
+        except NotFoundError:
+            return None

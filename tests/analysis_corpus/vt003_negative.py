@@ -40,6 +40,26 @@ class GoodCache:
             self.store.delete("Pod", "ns", "p")  # vclint: disable=VT003 - single-threaded bootstrap, store has no watchers yet
 
 
+class GoodPipeline:
+    """Pipeline scope, discipline followed: snapshot/fingerprint under
+    the lock, dispatch and fetch strictly after it — the flush of cycle N
+    overlaps the solve of N+1 without the cache lock bridging queues."""
+
+    def __init__(self, cache):
+        self.cache = cache
+        self._lock = threading.Lock()
+
+    def solve_ahead(self, spec, layout, staged):
+        with self._lock:
+            fingerprint = self.cache.fingerprint()
+        dev = solve_rounds_packed(spec, layout, staged)  # after release
+        return fingerprint, devprof.start_fetch(dev)
+
+    def legacy_probe(self, spec, layout, staged):
+        with self._lock:
+            return solve_rounds_packed(spec, layout, staged)  # vclint: disable=VT003 - cold-start probe before any watcher attaches; nothing can contend
+
+
 class GoodElector:
     """HA scope, discipline followed: the lease write happens after the
     record lock is released; the breaker gate never calls back into a

@@ -29,6 +29,26 @@ class BadCache:
         self.store.update_status(job)  # vclint-expect: VT003
 
 
+class BadPipeline:
+    """Pipeline scope: a device dispatch (or the devprof fetch seam)
+    under the cache lock bridges host and device queues — every watch
+    handler and effector stalls behind async device work (worse: an
+    implicit compile)."""
+
+    def __init__(self, cache):
+        self.cache = cache
+        self._lock = threading.Lock()
+
+    def solve_ahead(self, spec, layout, staged):
+        with self._lock:
+            return solve_rounds_packed(spec, layout, staged)  # vclint-expect: VT003
+
+    def fetch_under_lock(self, dev):
+        with self._lock:
+            wait = devprof.start_fetch(dev)  # vclint-expect: VT003
+        return wait
+
+
 class BadElector:
     """HA scope: the lease record lock sits UNDER the store lock in the
     callback graph — renewing (a store write) while holding it inverts

@@ -260,3 +260,97 @@ class TestWindowSessions:
             cache2, prof2 = _run_rounds_session(_window_session_cluster(38))
         assert prof2.get("window_k") == prof.get("window_k")
         assert prof2.get("dirty_k") == prof.get("dirty_k")
+
+
+# ---------------------------------------------------------------------------
+# mesh-aware window ladder (ROADMAP item 3): window_k/dirty_k size off the
+# PER-SHARD node count under a device mesh, with identical bucket keys (and
+# therefore identical compiled programs) at 1 device
+# ---------------------------------------------------------------------------
+
+
+def _wf_arrays(nodes, tasks, classes=4, idle=4.0, req=1.0):
+    return {
+        "node_idle": np.full((nodes, 2), idle),
+        "task_cls": (np.arange(tasks) % classes).astype(np.int32),
+        "cls_req": np.full((classes, 2), req),
+    }
+
+
+class TestMeshWindowLadder:
+    def test_one_device_bucket_keys_unchanged(self):
+        """shards=1 must reproduce the pre-mesh ladder exactly — the
+        window/dirty buckets are jit keys, so any drift here would
+        recompile every single-device deployment on upgrade."""
+        from volcano_tpu.ops.solver import _window_fields
+
+        for nodes, tasks in [(1024, 256), (4096, 1024), (512, 64)]:
+            arrays = _wf_arrays(nodes, tasks)
+            default = _window_fields(arrays)
+            assert default == _window_fields(arrays, shards=1), (nodes, tasks)
+            assert default["window_k"] > 0, (nodes, tasks, default)
+
+    def test_window_disables_when_shard_slice_too_small(self):
+        """A window spanning most of each shard's slice prunes nothing:
+        the ladder must judge coverage against the per-shard node count,
+        not global N."""
+        from volcano_tpu.ops.solver import _window_fields
+
+        arrays = _wf_arrays(128, 64)
+        one = _window_fields(arrays, shards=1)
+        eight = _window_fields(arrays, shards=8)
+        assert one["window_k"] > 0, one
+        assert eight == {"window_k": 0, "dirty_k": 0}, eight
+
+    def test_dirty_gather_caps_off_per_shard_count(self):
+        """dirty_k's node-count cap shrinks with the shard slice — a
+        gather sized off global N would fetch shards x the useful
+        columns."""
+        from volcano_tpu.ops.solver import _bucket, _window_fields
+
+        arrays = _wf_arrays(8192, 512)
+        one = _window_fields(arrays, shards=1)
+        eight = _window_fields(arrays, shards=8)
+        assert one["window_k"] == eight["window_k"], (one, eight)
+        assert eight["dirty_k"] <= one["dirty_k"], (one, eight)
+        k = eight["window_k"]
+        assert eight["dirty_k"] == min(
+            _bucket(max(4 * k, 64)), _bucket(max(8192 // 8 // 8, 64)))
+
+    def test_sharded_session_binds_match_unsharded(self):
+        """End-to-end under the 8-device mesh: the mesh-aware ladder may
+        pick different (incl. disabled) windows per shard count, but
+        bindings must stay bit-identical to the single-device session —
+        the coverage machinery's exactness contract is shard-blind."""
+        import jax
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        assert len(devs) >= 8, devs
+        populate = _window_session_cluster(40)
+
+        def run(mesh):
+            cache = make_cache()
+            populate(cache)
+            ssn = open_session(
+                cache, make_tiers(["tpuscore"],
+                                  ["priority", "gang"],
+                                  ["predicates", "binpack", "proportion"],
+                                  arguments=ROUNDS_ARGS))
+            if mesh is not None:
+                ssn.plugins["tpuscore"].mesh = mesh
+                ssn.batch_allocator.mesh = mesh
+            get_action("allocate").execute(ssn)
+            prof = dict(ssn.plugins["tpuscore"].profile)
+            close_session(ssn)
+            assert prof.get("mode") == "rounds", prof
+            return dict(cache.binder.binds), prof
+
+        sharded, s_prof = run(Mesh(np.array(devs[:8]), ("nodes",)))
+        unsharded, u_prof = run(None)
+        assert sharded == unsharded
+        # the single-device arm ran windowed; the 8-shard arm's 16-node
+        # slices disable the window (2k > n_shard) — different program,
+        # same bindings
+        assert u_prof.get("window_k", 0) > 0, u_prof
+        assert s_prof.get("window_k", 1) == 0, s_prof

@@ -1,0 +1,445 @@
+"""Continuous scheduling pipeline (volcano_tpu/pipeline) vs the serial loop.
+
+The contract (DESIGN.md §16): for the SAME per-cycle delta trace, the
+pipelined loop — double-buffered snapshots, speculative solve-ahead sealed
+by a delta fingerprint — lands EXACTLY the cache/effector end state the
+serial open->actions->close loop lands, with speculation forced on, forced
+off, committed, or discarded. An invalidated speculative stage is never
+applied (the discard counters are the accounting proof; the parity fuzz is
+the behavioral one), and the stale-at-apply re-check never fires.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tests.helpers import close_session, make_cache, make_tiers, open_session
+from volcano_tpu.api import objects
+from volcano_tpu.scheduler.framework import run_actions
+from volcano_tpu.scheduler.util.test_utils import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    build_resource_list_with_pods,
+)
+
+ACTIONS = ["enqueue", "allocate", "backfill"]
+# rounds mode forced: the fuzz clusters sit below the auto threshold, and
+# the pipeline only solves ahead when allocate runs the packed rounds
+# dispatch (exactly the headline regime)
+ARGS = {"tpuscore": {"tpuscore.mode": "rounds"}}
+TIERS_SPEC = (["tpuscore"], ["priority", "gang"],
+              ["drf", "predicates", "proportion", "nodeorder"])
+
+
+def _mk_driver(cache, tiers, spec=True, intake=None):
+    from volcano_tpu.pipeline import PipelineDriver
+    from volcano_tpu.scheduler.degrade import DegradeLadder
+
+    return PipelineDriver(
+        cache, lambda: (ACTIONS, tiers), degrade=DegradeLadder(),
+        spec=spec, intake=intake)
+
+
+# -- deterministic cluster + delta trace -------------------------------------
+
+
+def _cluster(seed):
+    rng = random.Random(seed)
+    cache = make_cache()
+    cache.add_queue(build_queue("default"))
+    state = {"cache": cache, "rng": rng, "pods": {}, "n": 0}
+    # deliberately CPU-overcommitted (the cfg5_storm shape in miniature):
+    # a pending backlog persists across cycles, so every cycle re-runs the
+    # warm packed solve — the regime the solve-ahead seals
+    for n in range(rng.choice([2, 3])):
+        cache.add_node(build_node(
+            f"n{n:02d}", build_resource_list_with_pods("4", "12Gi",
+                                                       pods=64)))
+    for _ in range(rng.choice([8, 10])):
+        _add_gang(state)
+    return state
+
+
+def _add_gang(state):
+    i, rng, cache = state["n"], state["rng"], state["cache"]
+    state["n"] += 1
+    pg = f"pg-{i:04d}"
+    tasks = rng.choice([2, 3, 4])
+    cache.add_pod_group(build_pod_group(
+        pg, namespace="pl", min_member=max(1, tasks - 1),
+        phase=objects.PodGroupPhase.PENDING))
+    for t in range(tasks):
+        pod = build_pod(
+            "pl", f"{pg}-t{t}", "", objects.POD_PHASE_PENDING,
+            {"cpu": f"{rng.choice([500, 1000, 2000])}m", "memory": "1Gi"},
+            pg)
+        cache.add_pod(pod)
+        state["pods"][f"pl/{pg}-t{t}"] = pod
+
+
+def _del_pod(state):
+    pods = state["pods"]
+    if not pods:
+        return
+    key = sorted(pods)[state["rng"].randrange(len(pods))]
+    state["cache"].delete_pod(pods.pop(key))
+
+
+def _schedule(seed, cycles):
+    """Per-cycle delta descriptors, a function of the seed alone so both
+    arms replay the identical trace. 'none' cycles are the speculation
+    windows; 'gang'/'del' are the watch deltas that must invalidate."""
+    rng = random.Random(seed * 7919)
+    kinds = ["none", "none", "gang", "none", "del", "none"]
+    return [rng.choice(kinds) for _ in range(cycles)]
+
+
+def _apply_delta(state, kind):
+    if kind == "gang":
+        _add_gang(state)
+    elif kind == "del":
+        _del_pod(state)
+
+
+def _signature(cache):
+    jobs = {}
+    for uid in sorted(cache.jobs):
+        job = cache.jobs[uid]
+        jobs[uid] = {
+            "phase": job.pod_group.status.phase
+            if job.pod_group is not None else None,
+            "tasks": {t: (int(job.tasks[t].status),
+                          job.tasks[t].node_name)
+                      for t in sorted(job.tasks)},
+        }
+    nodes = {}
+    for name in sorted(cache.nodes):
+        node = cache.nodes[name]
+        nodes[name] = (round(node.used.milli_cpu, 6),
+                       round(node.idle.milli_cpu, 6),
+                       round(node.used.memory, 3))
+    return {"jobs": jobs, "nodes": nodes,
+            "binds": dict(cache.binder.binds),
+            "evicts": list(getattr(cache.evictor, "evicts", []))}
+
+
+def _drive(seed, cycles, pipeline, spec=True):
+    state = _cluster(seed)
+    cache = state["cache"]
+    tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
+    drv = _mk_driver(cache, tiers, spec=spec) if pipeline else None
+    for kind in _schedule(seed, cycles):
+        _apply_delta(state, kind)
+        if drv is None:
+            ssn = open_session(cache, tiers)
+            try:
+                run_actions(ssn, ACTIONS)
+            finally:
+                close_session(ssn)
+        else:
+            drv.run_cycle()
+    if drv is not None:
+        drv.abandon()
+    cache.flush_mirror()
+    return _signature(cache), (dict(drv.stats) if drv else None)
+
+
+def _check_accounting(stats):
+    """The never-applied proof, as accounting: every dispatched stage is
+    either applied or discarded, every non-abandoned discard re-ran the
+    cycle serially, and the apply-time re-check never caught a stale
+    fingerprint (nothing may move state between the two probes)."""
+    assert stats["stale_commits"] == 0, stats
+    discards = stats["spec_discards"]
+    assert stats["spec_applied"] + stats["spec_discarded"] \
+        == stats["spec_dispatched"], stats
+    non_abandoned = sum(n for reason, n in discards.items()
+                       if reason != "abandoned")
+    assert non_abandoned == stats["spec_reruns"], stats
+
+
+@pytest.mark.parametrize("seed", [3, 17])
+def test_pipeline_parity_fuzz(seed):
+    """Same delta trace => identical end state (task statuses and
+    placements, node accounting, PodGroup phases, binds, evictions in
+    effector order) for serial, pipelined+speculative, and
+    pipelined-without-speculation."""
+    want, _ = _drive(seed, 10, pipeline=False)
+    got_spec, stats = _drive(seed, 10, pipeline=True, spec=True)
+    got_nospec, nstats = _drive(seed, 10, pipeline=True, spec=False)
+    assert got_spec == want, seed
+    assert got_nospec == want, seed
+    _check_accounting(stats)
+    # the no-speculation arm must never dispatch ahead
+    assert nstats["spec_dispatched"] == 0, nstats
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", list(range(400, 410)))
+def test_pipeline_parity_wide(seed):
+    want, _ = _drive(seed, 14, pipeline=False)
+    got, stats = _drive(seed, 14, pipeline=True, spec=True)
+    assert got == want, seed
+    _check_accounting(stats)
+
+
+def test_speculation_commits_on_quiet_cycles():
+    """Delta-free cycles are the speculation windows: with a standing
+    backlog and nothing moving between seal and apply, the solve-ahead
+    must actually commit (spec_applied > 0) — and a trace with watch
+    deltas must discard at least once with the watch_delta reason."""
+    state = _cluster(5)
+    cache = state["cache"]
+    tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
+    drv = _mk_driver(cache, tiers)
+    for _ in range(4):  # quiet back-to-back cycles
+        drv.run_cycle()
+    assert drv.stats["spec_applied"] >= 1, drv.stats
+    _add_gang(state)  # a watch delta lands on sealed state
+    drv.run_cycle()
+    assert drv.stats["spec_discards"].get("watch_delta", 0) >= 1, drv.stats
+    drv.abandon()
+    _check_accounting(drv.stats)
+
+
+def test_abandon_never_applies():
+    """abandon() (shutdown / lost leadership / crashed cycle) discards the
+    in-flight stage without any observable cache effect."""
+    from volcano_tpu.utils import devprof
+
+    state = _cluster(9)
+    cache = state["cache"]
+    tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
+    drv = _mk_driver(cache, tiers)
+    drv.run_cycle()
+    assert drv._inflight is not None  # solve-ahead left dispatched
+    before = _signature(cache)
+    drv.abandon()
+    assert _signature(cache) == before
+    assert drv.stats["spec_discards"].get("abandoned") == 1
+    devprof.drain()  # nothing in flight may dangle
+
+
+def test_express_commit_discards_and_tokens_drain():
+    """The express interaction contract: (a) a token minted AFTER the seal
+    (an express commit in the inter-cycle window) moves the lane's commit
+    epoch and discards the in-flight stage; (b) the re-run session drains
+    the token through normal reconciliation; (c) the speculation guard
+    refuses to seal while tokens are outstanding."""
+    from volcano_tpu.express.trigger import ExpressToken
+
+    state = _cluster(11)
+    cache = state["cache"]
+    tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
+    drv = _mk_driver(cache, tiers)
+
+    class _Lane:
+        outstanding = {}
+        commit_epoch = 0
+        session_seq = 0
+        last_reverts = []
+        counters = {"terminal": 0, "reconciled": 0, "reverted": 0,
+                    "batches": 0}
+        denylist = set()
+
+        def set_tiers(self, tiers):
+            pass
+
+    lane = cache.express_lane = _Lane()
+    drv.run_cycle()
+    assert drv._inflight is not None
+    # an express commit lands between seal and apply: epoch moves, a
+    # token appears (job unknown to sessions => terminal at reconcile)
+    lane.commit_epoch += 1
+    lane.outstanding["ghost/job"] = ExpressToken(
+        job_uid="ghost/job", binds={}, seq=lane.session_seq, epoch=1)
+    # (c) the guard, probed directly: speculation refuses to seal past an
+    # unresolved token
+    info = {}
+    drv._speculate(ACTIONS, ACTIONS, tiers, info)
+    assert drv.stats["spec_skips"].get("express_tokens") == 1, drv.stats
+    # (a)+(b): the cycle discards the stale stage, re-runs serially, and
+    # the committing session's reconcile drains the token
+    drv.run_cycle()
+    assert drv.stats["spec_discards"].get("express_commit", 0) >= 1, \
+        drv.stats
+    assert not lane.outstanding
+    assert lane.counters["terminal"] == 1
+    drv.abandon()
+    _check_accounting(drv.stats)
+
+
+def test_fence_epoch_discards_speculation():
+    """A leadership change between seal and apply must kill the in-flight
+    stage through the fingerprint's fence component (the PR 8 takeover
+    path: a new term never applies a deposed term's solve)."""
+    state = _cluster(13)
+    cache = state["cache"]
+    tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
+    drv = _mk_driver(cache, tiers)
+    drv.run_cycle()
+    assert drv._inflight is not None
+    cache.set_fence_epoch(7)
+    drv.run_cycle()
+    assert drv.stats["spec_discards"].get("fence_epoch", 0) >= 1, drv.stats
+    drv.abandon()
+    _check_accounting(drv.stats)
+
+
+def test_policy_meta_delta_discards_speculation():
+    """A queue spec update (weight change) between seal and apply has no
+    per-object dirty mark — QueueInfos re-derive fresh each snapshot —
+    but the sealed solve read the OLD policy, so the keeper's meta epoch
+    must invalidate the stage."""
+    state = _cluster(19)
+    cache = state["cache"]
+    tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
+    drv = _mk_driver(cache, tiers)
+    drv.run_cycle()
+    assert drv._inflight is not None
+    cache.add_queue(build_queue("default", weight=7))  # spec update
+    drv.run_cycle()
+    assert drv.stats["spec_discards"].get("watch_delta", 0) >= 1, drv.stats
+    drv.abandon()
+    _check_accounting(drv.stats)
+
+
+def test_conf_change_discards_speculation():
+    """A hot-reloaded policy invalidates the sealed stage (tiers identity
+    is part of the fingerprint)."""
+    state = _cluster(15)
+    cache = state["cache"]
+    tiers_box = {"tiers": make_tiers(*TIERS_SPEC, arguments=ARGS)}
+    from volcano_tpu.scheduler.degrade import DegradeLadder
+    from volcano_tpu.pipeline import PipelineDriver
+
+    drv = PipelineDriver(
+        cache, lambda: (ACTIONS, tiers_box["tiers"]),
+        degrade=DegradeLadder(), spec=True)
+    drv.run_cycle()
+    assert drv._inflight is not None
+    tiers_box["tiers"] = make_tiers(*TIERS_SPEC, arguments=ARGS)
+    drv.run_cycle()
+    assert drv.stats["spec_discards"].get("conf_changed", 0) >= 1, drv.stats
+    drv.abandon()
+
+
+def test_intake_keeps_speculation_valid():
+    """Arrivals funneled through the intake hook land BEFORE the seal, so
+    they ride the next speculative snapshot instead of invalidating it —
+    and the end state still matches the serial loop fed the same trace at
+    the same points."""
+    state = _cluster(21)
+    cache = state["cache"]
+    tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
+    pending = []
+
+    def intake():
+        while pending:
+            _apply_delta(state, pending.pop(0))
+
+    drv = _mk_driver(cache, tiers, intake=intake)
+    trace = ["none", "gang", "none", "gang", "none", "none"]
+    for kind in trace:
+        pending.append(kind)
+        drv.run_cycle()
+    drv.abandon()
+    cache.flush_mirror()
+    got = _signature(cache)
+    stats = dict(drv.stats)
+    # intake-quantized arrivals never invalidate
+    assert stats["spec_discards"].get("watch_delta", 0) == 0, stats
+    assert stats["spec_applied"] >= 2, stats
+
+    # serial arm: the same arrivals applied at the same quantization
+    # points (right after each committed cycle => visible to the next)
+    state2 = _cluster(21)
+    cache2 = state2["cache"]
+    for kind in trace:
+        _apply_delta(state2, kind)
+        ssn = open_session(cache2, tiers)
+        try:
+            run_actions(ssn, ACTIONS)
+        finally:
+            close_session(ssn)
+    cache2.flush_mirror()
+    assert got == _signature(cache2)
+
+
+def test_pipeline_disabled_rung_falls_back():
+    """Repeated pipelined-cycle errors open the ladder's pipeline breaker:
+    pipeline_allowed() goes False (the scheduler loop then runs the serial
+    run_once oracle) and the rung reads pipeline_disabled."""
+    from volcano_tpu.scheduler.degrade import DegradeLadder
+
+    ladder = DegradeLadder(pipeline_threshold=3)
+    assert ladder.pipeline_allowed()
+    for _ in range(3):
+        ladder.note_pipeline_error()
+    assert not ladder.pipeline_allowed()
+    assert ladder.rung() == "pipeline_disabled"
+    ladder.note_pipeline_ok()
+    assert ladder.pipeline_allowed()
+
+
+def test_crashed_cycle_abandons_and_meters(monkeypatch):
+    """A cycle that raises must not strand a half-dispatched speculation,
+    and must feed the ladder's pipeline breaker."""
+    state = _cluster(23)
+    cache = state["cache"]
+    tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
+    drv = _mk_driver(cache, tiers)
+    drv.run_cycle()
+    assert drv._inflight is not None
+
+    def boom(*a, **k):
+        raise RuntimeError("policy exploded")
+
+    drv.policy_fn = boom
+    with pytest.raises(RuntimeError):
+        drv.run_cycle()
+    assert drv._inflight is None
+    assert drv.stats["spec_discards"].get("abandoned") == 1
+    assert drv.degrade.pipeline.stats["failures"] >= 1
+
+
+def test_scheduler_pipeline_mode(monkeypatch):
+    """Scheduler(pipeline=True) drives cycles through the driver;
+    VOLCANO_TPU_PIPELINE=0 keeps the serial loop (driver never built)."""
+    import time
+
+    from volcano_tpu.scheduler.scheduler import Scheduler
+
+    monkeypatch.delenv("VOLCANO_TPU_PIPELINE", raising=False)
+    state = _cluster(31)
+    cache = state["cache"]
+    s = Scheduler(cache, schedule_period=0.05, pipeline=True)
+    s.run()
+    try:
+        assert cache.binder.wait_for_binds(1, timeout=10.0)
+        deadline = time.time() + 5.0
+        while s.pipeline_driver is None and time.time() < deadline:
+            time.sleep(0.01)
+        assert s.pipeline_driver is not None
+        deadline = time.time() + 5.0
+        while s.pipeline_driver.stats["committed"] == 0 \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert s.pipeline_driver.stats["committed"] >= 1
+    finally:
+        s.stop()
+    assert s.pipeline_driver._inflight is None  # abandoned at stop
+
+    monkeypatch.setenv("VOLCANO_TPU_PIPELINE", "0")
+    state2 = _cluster(31)
+    s2 = Scheduler(state2["cache"], schedule_period=0.05, pipeline=True)
+    s2.run()
+    try:
+        assert state2["cache"].binder.wait_for_binds(1, timeout=10.0)
+        assert s2.pipeline_driver is None
+    finally:
+        s2.stop()

@@ -245,6 +245,39 @@ class TestObservabilityEndpoints:
         assert f'{h}_bucket{{le="0.005"}} 2' in lines
         metrics.reset()
 
+    def test_metrics_pipeline_series(self):
+        """Continuous-pipeline observability on /metrics: the sustained
+        sessions/sec gauge, the per-reason speculation-discard counter
+        (the never-applied proof surfaced to operators), and the overlap
+        histogram with its mandatory le=\"+Inf\" bucket."""
+        metrics.reset()
+        metrics.set_pipeline_sessions_per_sec(12.5)
+        metrics.register_pipeline_spec_discard("watch_delta", 3)
+        metrics.register_pipeline_spec_discard("express_commit")
+        metrics.observe_pipeline_overlap(0.002)
+        metrics.observe_pipeline_overlap(0.05)
+        srv = ObservabilityServer(":0").start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=5).read().decode()
+        finally:
+            srv.stop()
+        lines = body.splitlines()
+        assert "# TYPE volcano_pipeline_sessions_per_sec gauge" in lines
+        assert "volcano_pipeline_sessions_per_sec 12.5" in lines
+        c = "volcano_pipeline_spec_discards_total"
+        assert f"# TYPE {c} counter" in lines
+        assert f'{c}{{reason="watch_delta"}} 3.0' in lines
+        assert f'{c}{{reason="express_commit"}} 1.0' in lines
+        h = "volcano_pipeline_overlap_seconds"
+        assert f"# TYPE {h} histogram" in lines
+        assert f"{h}_count 2" in lines
+        assert f'{h}_bucket{{le="+Inf"}} 2' in lines
+        # the bucket ladder resolves the small-overlap regime
+        assert f'{h}_bucket{{le="0.0025"}} 1' in lines
+        metrics.reset()
+
     def test_healthz(self):
         healthy = {"ok": True}
         srv = ObservabilityServer(

@@ -243,6 +243,49 @@ class TestSmokeScenarios:
         assert [strip_warmth(t) for t in a["ha"]["takeovers"]] \
             == [strip_warmth(t) for t in b["ha"]["takeovers"]]
 
+    def test_pipeline_storm_speculation_and_mid_spec_kill_clean(self):
+        """pipeline_storm smoke (reduced scale): the pipelined session
+        loop under Poisson churn + express arrivals, with a leader kill
+        landing while a speculative solve is in flight. The auditor's
+        pipeline_no_stale_commit ledger (and every standing rule) must
+        hold; the speculation must BOTH commit on quiet windows and
+        discard on deltas; the mid_spec takeover must recover through the
+        fencing path with zero wholesale rebuilds and no double-apply."""
+        cfg = scale_scenario(load_scenario("pipeline_storm"), 0.25)
+        s = SimCluster(cfg, seed=7).run(duration=100.0)
+        assert s["audit"]["violations"] == 0, s["audit"]
+        pipe = s["pipeline"]
+        assert pipe is not None and pipe["cycles"] >= 20, pipe
+        # both halves of the speculation contract actually exercised
+        assert pipe["spec_applied"] >= 1, pipe
+        assert pipe["spec_discards"].get("watch_delta", 0) >= 1, pipe
+        # express commits between cycles invalidate sealed stages
+        assert pipe["spec_discards"].get("express_commit", 0) >= 1, pipe
+        # never-applied, as accounting: zero stale commits, every
+        # non-abandoned discard re-ran serially
+        assert pipe["stale_commits"] == 0, pipe
+        non_abandoned = sum(
+            n for r, n in pipe["spec_discards"].items() if r != "abandoned")
+        assert non_abandoned == pipe["spec_reruns"], pipe
+        # the mid_spec kill actually deposed a leader with a solve in
+        # flight, and the takeover met the warm-standby contract (both
+        # snapshot buffers warm => zero wholesale rebuilds)
+        ha = s["ha"]
+        assert ha["leader_kills"].get("mid_spec", 0) >= 1, ha
+        assert len(ha["takeovers"]) >= 1
+        for t in ha["takeovers"]:
+            assert t["rebuilds_delta"] == 0, t
+            assert t["first_session_compiles"] == 0, t
+            assert t["undrained_tokens"] == [], t
+
+    def test_pipeline_storm_same_seed_identical_hash(self):
+        cfg = scale_scenario(load_scenario("pipeline_storm"), 0.25)
+        a = SimCluster(cfg, seed=11).run(duration=60.0)
+        b = SimCluster(cfg, seed=11).run(duration=60.0)
+        assert a["event_log_hash"] == b["event_log_hash"]
+        assert a["pipeline"] == b["pipeline"]
+        assert a["binds"] == b["binds"]
+
 
 # ---------------------------------------------------------------------------
 # 3. auditor self-test (seeded bug fixtures)

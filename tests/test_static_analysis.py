@@ -108,6 +108,11 @@ class TestFramework:
         assert vt1.applies_to("volcano_tpu/ops/kernels.py")
         assert vt1.applies_to(str(REPO / "volcano_tpu/ops/rounds.py"))
         assert not vt1.applies_to("volcano_tpu/controllers/queue.py")
+        # the continuous pipeline sits inside the lock-discipline,
+        # hot-path-determinism, and donated-buffer scopes
+        for rid in ("VT003", "VT005", "VT006"):
+            assert get_rule(rid).applies_to(
+                "volcano_tpu/pipeline/driver.py"), rid
         vt3 = get_rule("VT003")
         assert vt3.applies_to("volcano_tpu/controllers/job/controller.py")
         assert vt3.applies_to("volcano_tpu/scheduler/cache/cache.py")

@@ -181,7 +181,13 @@ class BucketShape(Rule):
                      # express window sink: window_for/task_bucket wrap
                      # _bucket (express/place.py) — their results are
                      # ladder values by construction
-                     "window_for", "task_bucket"}
+                     "window_for", "task_bucket",
+                     # the solver window ladder itself: every value it
+                     # returns passed through _bucket (or is the 0
+                     # disable sentinel), including the mesh-aware
+                     # per-shard sizing whose `shards` input is a raw
+                     # device count
+                     "_window_fields"}
     PAD_FUNCS = {"_pad_axis"}
     SPEC_CTORS = {"SolveSpec", "EvictSpec", "ExpressSpec"}
     KERNEL_ENTRIES = {"solve_allocate", "solve_rounds", "solve_rounds_packed",
@@ -416,7 +422,13 @@ class LockDiscipline(Rule):
                 # apply (scheduler/ha.py elector callbacks fire on the
                 # elector thread; degrade.py gates run inside sessions)
                 "*/scheduler/ha.py", "*/scheduler/degrade.py",
-                "*/scheduler/leaderelection.py")
+                "*/scheduler/leaderelection.py",
+                # the continuous pipeline interleaves cache reads with
+                # device dispatches on one thread: holding the cache lock
+                # across a dispatch would stall every watch handler and
+                # effector behind an async device queue (and an implicit
+                # compile can turn that into seconds)
+                "*/pipeline/*.py")
 
     _LOCK_ATTR = re.compile(r"(^|_)(lock|mu|mutex|cond)$")
     STORE_MUTATORS = {
@@ -424,6 +436,22 @@ class LockDiscipline(Rule):
         "record_event", "record_events", "record_events_raw",
         "record_scheduled", "watch",
     }
+    # device-dispatch sinks (ops/ entrypoints + the devprof fetch seam +
+    # raw device placement): none of these may run under a held lock —
+    # the flush of cycle N must overlap the solve of N+1 WITHOUT the
+    # cache lock bridging host and device queues
+    DEVICE_DISPATCH = {
+        "solve_rounds_packed", "solve_rounds", "solve_allocate",
+        "solve_express", "solve_preempt", "solve_reclaim",
+        "solve_backfill", "solve_fused_chain", "start_fetch",
+        "device_put",
+    }
+
+    def _is_device_dispatch(self, call: ast.Call) -> bool:
+        func = call.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        return name in self.DEVICE_DISPATCH
 
     def _lock_attr(self, node: ast.AST) -> Optional[str]:
         if isinstance(node, ast.Attribute) \
@@ -525,6 +553,16 @@ class LockDiscipline(Rule):
                                 f"mutations dispatch synchronous watch "
                                 f"callbacks (lock-order inversion); move the "
                                 f"write after the lock is released"))
+                        elif self._is_device_dispatch(sub):
+                            findings.append(Finding(
+                                self.id, path, sub.lineno, sub.col_offset,
+                                f"device dispatch {dotted(sub.func)}() "
+                                f"under self.{held[0]} in {cls.name}.{name} "
+                                f"— a dispatch (and any implicit compile) "
+                                f"must never run with a lock held: every "
+                                f"watch handler and effector stalls behind "
+                                f"the device queue; snapshot under the "
+                                f"lock, dispatch after it"))
 
             for hname in self._handler_names(cls) & set(methods):
                 for node in ast.walk(methods[hname]):
@@ -654,7 +692,12 @@ class HotPathDeterminism(Rule):
                 # hash contract — set-order nondeterminism in takeover or
                 # degradation paths would fork active and standby
                 "*/scheduler/ha.py", "*/scheduler/degrade.py",
-                "*/scheduler/leaderelection.py")
+                "*/scheduler/leaderelection.py",
+                # the pipeline's commit/discard decisions (fingerprints,
+                # staged enqueue flips, release sweeps) feed real binds
+                # and the sim's hash contract — same determinism bar as
+                # the encoder and the express lane
+                "*/pipeline/*.py")
 
     _SET_CTORS = {"set", "frozenset"}
     _SET_METHODS = {"union", "intersection", "difference",
@@ -870,7 +913,11 @@ class DonatedBufferReuse(Rule):
                 # express device buffers are long-lived; if a future
                 # revision donates them for in-place patching, the reuse
                 # contract applies identically
-                "*/express/*.py")
+                "*/express/*.py",
+                # the pipeline holds dispatched (possibly donated) solve
+                # results across cycle boundaries — a discarded stage's
+                # buffers must never be read host-side afterwards
+                "*/pipeline/*.py")
 
     @staticmethod
     def _donated_positions(tree: ast.AST) -> Dict[str, tuple]:

@@ -87,9 +87,10 @@ def commit_batch(cache, lane, jobs: List[Tuple[object, list]],
             binds[task.uid] = (task.key, node_name)
             placed += 1
         if binds:
+            lane.commit_epoch += 1
             lane.outstanding[job.uid] = ExpressToken(
                 job_uid=job.uid, binds=binds, seq=lane.session_seq,
-                stamp=clock.now())
+                stamp=clock.now(), epoch=lane.commit_epoch)
         if not ok:
             deferred += 1
         if fenced:

@@ -27,6 +27,20 @@ token recorded since the previous session:
 Every token is resolved within ONE session — the invariant the
 simulator's auditor now checks continuously (sim/auditor.py
 express_reconciliation rule).
+
+Continuous-pipeline interaction (volcano_tpu/pipeline): a SPECULATIVE
+session — opened and dispatched ahead of the previous cycle's close —
+never reconciles; only the session that actually COMMITS does, and it
+bumps ``lane.session_seq`` exactly once. Tokens carry the lane's
+``commit_epoch`` at mint time, and the pipeline seals that epoch into its
+dispatch fingerprint: an express commit landing while a speculative solve
+is in flight moves the epoch, the speculative stage is discarded unapplied
+(``pipeline_spec_discard{reason="express_commit"}``), and the token drains
+through the re-run — the session that commits, never the one in flight.
+The pipeline also refuses to START speculating while tokens are
+outstanding (their reverts must free capacity BEFORE the solve encodes),
+so a reconcile verdict is always computed by the same session whose
+placements it shapes.
 """
 
 from __future__ import annotations

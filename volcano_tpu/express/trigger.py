@@ -72,6 +72,13 @@ class ExpressToken:
     binds: Dict[str, Tuple[str, str]]  # task uid -> (task key, node name)
     seq: int                           # lane.session_seq at commit time
     stamp: float = 0.0
+    # lane.commit_epoch at commit time: the continuous pipeline's
+    # speculative solve-ahead seals this epoch at dispatch — a token
+    # minted after the seal proves an express commit landed on state the
+    # in-flight solve already read, so the SPECULATIVE session is
+    # discarded and the token reconciles against the session that
+    # actually commits (pipeline/driver.py fingerprint)
+    epoch: int = 0
 
 
 @dataclass
@@ -123,6 +130,11 @@ class ExpressLane:
         # reconcile's reverts — the auditor's zero-residue probe
         self.last_reverts: List[Tuple[str, str, str]] = []
         self.session_seq = 0
+        # monotone commit counter (one bump per committed batch): the
+        # pipeline fingerprint's express component — cheaper to compare
+        # than the outstanding-token dict, and it moves even for tokens
+        # that resolve terminally before the check
+        self.commit_epoch = 0
         self.counters = {"arrivals": 0, "placed": 0, "deferred": 0,
                          "reconciled": 0, "reverted": 0, "terminal": 0,
                          "batches": 0, "errors": 0}

@@ -35,7 +35,7 @@ def _bucket(n: int) -> int:
     return b
 
 
-def _window_fields(arrays) -> Dict[str, int]:
+def _window_fields(arrays, shards: int = 1) -> Dict[str, int]:
     """Candidate-window sizing for the rounds kernel, off the bucket ladder.
 
     window_k bounds the per-class top-k node nomination: sized from class
@@ -47,12 +47,26 @@ def _window_fields(arrays) -> Dict[str, int]:
     dirty_k bounds the dirty-column rescoring gather the same way. Both 0
     (full-width sweeps, the pre-window behavior and the parity-fuzz
     reference) when the window would cover most of the node axis anyway,
-    or when VOLCANO_TPU_WINDOW=0 forces the old path."""
+    or when VOLCANO_TPU_WINDOW=0 forces the old path.
+
+    ``shards`` is the mesh device count sharding the node axis (ROADMAP
+    item 3): the windowed gathers and dirty-column rescores are
+    node-parallel, so "covers most of the axis" and the dirty-gather cap
+    must be judged against the PER-SHARD node count — at 8 devices a
+    window that spans a whole shard's slice buys nothing on that shard,
+    and a dirty_k sized off global N would gather 8x the useful columns.
+    At shards=1 every value (and therefore every compiled-program bucket
+    key) is identical to the pre-mesh ladder. Bindings are unaffected
+    either way — the per-class coverage bit routes any truncated window
+    to the full-width exactness fallback."""
     import os
 
     if os.environ.get("VOLCANO_TPU_WINDOW", "1") == "0":
         return {"window_k": 0, "dirty_k": 0}
     nb = int(np.asarray(arrays["node_idle"]).shape[0])
+    # per-shard slice of the sharded node axis; the mesh pad made nb an
+    # exact multiple of the device count (pad_encoded node_multiple)
+    n_shard = max(nb // max(int(shards), 1), 1)
     task_cls = np.asarray(arrays["task_cls"])
     kb = int(np.asarray(arrays["cls_req"]).shape[0])
     demand = np.bincount(task_cls, minlength=kb).astype(np.float64)
@@ -67,13 +81,14 @@ def _window_fields(arrays) -> Dict[str, int]:
                    float(max(task_cls.shape[0], 1)))
     need = int(np.ceil(demand / cap).max(initial=1.0))
     k = _bucket(max(16, 2 * need))
-    if 2 * k > nb:
-        # window would span most of the axis: pruning buys nothing and the
-        # coverage machinery would only add per-round overhead
+    if 2 * k > n_shard:
+        # window would span most of (each shard's slice of) the axis:
+        # pruning buys nothing and the coverage machinery would only add
+        # per-round overhead
         return {"window_k": 0, "dirty_k": 0}
     return {"window_k": k,
             "dirty_k": min(_bucket(max(4 * k, 64)),
-                           _bucket(max(nb // 8, 64)))}
+                           _bucket(max(n_shard // 8, 64)))}
 
 
 def _pad_axis(a: np.ndarray, axis: int, size: int, fill=0):
@@ -423,7 +438,7 @@ class BatchAllocator:
                 # cheaper than the serial pass they would shed
                 tb = int(arrays["task_cls"].shape[0])
                 kb = int(arrays["cls_req"].shape[0])
-                wf = _window_fields(arrays)
+                wf = _window_fields(arrays, shards=node_multiple)
                 spec = enc.spec._replace(
                     round_min_progress=(
                         max(2, tb // 128) if kb > rounds_mod.CHUNK else 0),

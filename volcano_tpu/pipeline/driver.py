@@ -1,0 +1,564 @@
+"""Continuous scheduling pipeline — double-buffered sessions with
+speculative solve-ahead (ROADMAP item 2: sessions/sec as the headline).
+
+The serial loop runs snapshot -> actions -> effectors -> close strictly
+in sequence, so the device idles while the host closes a session and the
+host idles while the device solves. This driver overlaps the phases of
+CONSECUTIVE cycles instead, on one host thread (determinism — the only
+concurrency is the device's own async execution):
+
+    apply N   -> open N+1 (buffer swap, delta-open) -> dispatch N+1
+              -> close N  (status writebacks, JobUpdater — overlapped
+                           with N+1's device solve)
+              -> [inter-cycle work: controllers, express, waits]
+    cycle N+1 -> fingerprint check -> apply N+1 (speculation held)
+                                   or discard + re-run (state moved)
+
+Double buffer: the SnapshotKeeper's buffer pair (snapkeeper.py
+enable_pair/swap) gives session N+1 its own clone set while session N's
+close still reads its snapshot; every cache mark lands in both buffers'
+dirty sets, so each buffer delta-maintains independently.
+
+Speculation contract: cycle N+1's session is opened and its packed
+rounds solve dispatched BEFORE cycle N's close (whose status writebacks
+could, in principle, change state) and before any inter-cycle delta. A
+delta fingerprint — the keeper's dirty epoch + generation, the lease
+fence epoch, the summed cache-node accounting generation, and the
+express lane's commit epoch — is sealed at dispatch and re-checked
+before apply. ANY movement means the speculative snapshot is stale: the
+stage is discarded (never fetched into session state, counted per reason
+as ``pipeline_spec_discard{reason}``) and the cycle re-runs
+non-speculatively on fresh state — which is exactly the serial order, so
+the serial loop (``VOLCANO_TPU_PIPELINE=0``) stays the byte-for-byte
+oracle whether speculation is on, off (``VOLCANO_TPU_PIPELINE_SPEC=0``),
+held, or discarded.
+
+Enqueue runs STAGED in a speculative session: the real EnqueueAction
+executes, the Pending->Inqueue flips (which land on the SHARED PodGroup
+objects) are recorded and immediately reverted, and they re-apply only
+at commit time — a discarded speculative session must leave zero
+observable state. A staged flip whose job already has pending tasks
+would change what the solve encodes (the serial order admits it before
+allocate), so that cycle declines to speculate (``enqueue_active``)
+instead of risking parity. Under delayed pod creation (the production
+admission gate) this never triggers in steady state.
+
+Envelope: the pipelined fast path covers action chains of the shape
+``[enqueue,] allocate[, backfill]`` whose allocate runs the packed rounds
+solve (solver._prepare/parse_packed/apply_packed are the stage
+boundaries). Anything else — preempt/reclaim chains (the fused
+session dispatch owns those), serial-fallback sessions, custom plugins —
+runs through the ordinary ``framework.run_actions`` per cycle, unpipelined
+but correct (``fallback_cycles``). Repeated pipelined-cycle ERRORS open
+the degrade ladder's ``pipeline_disabled`` breaker and the scheduler loop
+reverts to serial run_once until the half-open probe passes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from volcano_tpu.api import objects
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.framework import (
+    close_session,
+    get_action,
+    open_session,
+    run_actions,
+    takeover_recovery_sweep,
+)
+
+logger = logging.getLogger(__name__)
+
+# the pipelined chain grammar: allocate, optionally preceded by enqueue
+# and followed by backfill — the packed rounds solve is the single device
+# stage whose dispatch can run ahead of the previous cycle's close
+_CHAIN = ("enqueue", "allocate", "backfill")
+
+
+def pipeline_enabled() -> bool:
+    """VOLCANO_TPU_PIPELINE=0 forces the serial loop (the oracle)."""
+    return os.environ.get("VOLCANO_TPU_PIPELINE", "1") != "0"
+
+
+def speculation_enabled() -> bool:
+    """VOLCANO_TPU_PIPELINE_SPEC=0 keeps the pipelined loop but never
+    dispatches ahead (double-buffer-only mode)."""
+    return os.environ.get("VOLCANO_TPU_PIPELINE_SPEC", "1") != "0"
+
+
+class _InFlight:
+    """One speculative solve-ahead: the early-opened session, its
+    prepared packed dispatch, the sealed fingerprint, and the staged
+    enqueue flips that re-apply only at commit."""
+
+    __slots__ = ("ssn", "names", "prep", "dev", "wait", "fingerprint",
+                 "flips", "tiers", "t_dispatch")
+
+    def __init__(self, ssn, names, prep, dev, wait, fingerprint, flips,
+                 tiers, t_dispatch):
+        self.ssn = ssn
+        self.names = names
+        self.prep = prep
+        self.dev = dev
+        self.wait = wait
+        self.fingerprint = fingerprint
+        self.flips = flips
+        self.tiers = tiers
+        self.t_dispatch = t_dispatch
+
+
+class PipelineDriver:
+    """The pipelined cycle driver for one SchedulerCache.
+
+    ``policy_fn`` returns the cycle's (actions, tiers); the TIERS OBJECT
+    IDENTITY is part of the speculation fingerprint, so callers must hand
+    back the same object while the conf is unchanged (Scheduler caches
+    its parse on the conf text; the sim's conf is fixed).
+    """
+
+    # rolling window for the sustained sessions/sec gauge
+    _RATE_WINDOW = 32
+
+    def __init__(self, cache, policy_fn: Callable[[], Tuple[list, list]],
+                 degrade=None, spec: Optional[bool] = None,
+                 intake: Optional[Callable[[], None]] = None):
+        self.cache = cache
+        self.policy_fn = policy_fn
+        # None => the process-default ladder, resolved LAZILY per use:
+        # degrade.reset() (sim runs, tests) swaps the default instance,
+        # and a driver built before the reset must not gate on the stale
+        # one
+        self._degrade = degrade
+        self.spec = speculation_enabled() if spec is None else spec
+        # intake: drained AFTER the cycle commits and BEFORE the next
+        # cycle's snapshot seals — the watch-ingest quantization point.
+        # A driver (bench --pipeline, an embedder pumping a delta queue)
+        # that funnels arrivals through it makes them visible to the very
+        # next speculative snapshot instead of invalidating it mid-flight;
+        # deltas that bypass it (live watch events, express commits) are
+        # still caught by the fingerprint and discard the stage.
+        self.intake = intake
+        cache.enable_pipeline()
+        self._inflight: Optional[_InFlight] = None
+        self._cycle_walls: List[float] = []
+        self.stats: Dict[str, object] = {
+            "cycles": 0, "committed": 0, "fallback_cycles": 0,
+            "spec_dispatched": 0, "spec_applied": 0, "spec_discarded": 0,
+            "spec_reruns": 0, "stale_commits": 0,
+            "spec_discards": {}, "spec_skips": {},
+        }
+
+    @property
+    def degrade(self):
+        if self._degrade is not None:
+            return self._degrade
+        from volcano_tpu.scheduler import degrade as degrade_mod
+
+        return degrade_mod.default_ladder()
+
+    # -- fingerprint ---------------------------------------------------------
+
+    def _fingerprint(self, tiers) -> tuple:
+        lane = getattr(self.cache, "express_lane", None)
+        return (self.cache.pipeline_fingerprint(),
+                lane.commit_epoch if lane is not None else -1,
+                id(tiers))
+
+    def _check(self, st: _InFlight, tiers) -> Tuple[bool, str]:
+        now = self._fingerprint(tiers)
+        old = st.fingerprint
+        if now == old:
+            return True, ""
+        # attribute the discard to the first component that moved — the
+        # metric label operators alert on
+        (o_cache, o_epoch, o_tiers), (n_cache, n_epoch, n_tiers) = old, now
+        if o_tiers != n_tiers:
+            return False, "conf_changed"
+        if o_epoch != n_epoch:
+            return False, "express_commit"
+        if o_cache[2] != n_cache[2]:
+            return False, "fence_epoch"
+        if o_cache[1] != n_cache[1]:
+            return False, "generation"
+        if o_cache[0] != n_cache[0]:
+            return False, "watch_delta"
+        return False, "acct_gen"
+
+    # -- cycle entry ---------------------------------------------------------
+
+    def run_cycle(self) -> Dict:
+        """One COMMITTED session per call (plus, usually, the next
+        cycle's speculative dispatch left in flight). Returns the cycle
+        info dict (mode, timings, speculation outcome)."""
+        t_cycle = time.perf_counter()
+        info: Dict[str, object] = {}
+        st, self._inflight = self._inflight, None
+        try:
+            actions, tiers = self.policy_fn()
+            names = [a if isinstance(a, str) else a.name() for a in actions]
+            if st is not None:
+                ok, reason = self._check(st, tiers)
+                if ok:
+                    pending, st = st, None
+                    ssn = self._commit(pending, info)
+                    if ssn is None:  # kernel failure at fetch: rerun
+                        ssn = self._full_cycle(actions, names, tiers, info)
+                else:
+                    self._discard(st, reason)
+                    st = None
+                    self.stats["spec_reruns"] += 1
+                    info["spec"] = f"discarded:{reason}"
+                    ssn = self._full_cycle(actions, names, tiers, info)
+            else:
+                ssn = self._full_cycle(actions, names, tiers, info)
+            self.stats["committed"] += 1
+            if self.intake is not None:
+                # quantized delta ingest: arrivals drained here are INSIDE
+                # the next snapshot's seal instead of invalidating it
+                self.intake()
+            # solve-ahead for the NEXT cycle, dispatched before this
+            # session's close so the device works through the close-side
+            # host writebacks and the inter-cycle window
+            self._speculate(actions, names, tiers, info)
+            t0 = time.perf_counter()
+            close_session(ssn)
+            info["close_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        except Exception:
+            # a crashed pipelined cycle must not strand a half-dispatched
+            # speculation — neither the stage detached at entry nor one
+            # this cycle dispatched; the degrade ladder decides how many
+            # crashes buy a fallback to the serial loop
+            if st is not None:
+                self._discard(st, "abandoned")
+            self.abandon()
+            self.degrade.note_pipeline_error()
+            raise
+        self.degrade.note_pipeline_ok()
+        self.stats["cycles"] += 1
+        wall = time.perf_counter() - t_cycle
+        info["e2e_ms"] = round(wall * 1e3, 3)
+        self._cycle_walls.append(wall)
+        if len(self._cycle_walls) > self._RATE_WINDOW:
+            del self._cycle_walls[0]
+        total = sum(self._cycle_walls)
+        if total > 0:
+            metrics.set_pipeline_sessions_per_sec(
+                round(len(self._cycle_walls) / total, 3))
+        return info
+
+    def abandon(self) -> None:
+        """Drop any in-flight speculation without applying it (shutdown,
+        leadership loss, crashed cycle). The discard counter stays honest
+        — an abandoned stage was never applied either."""
+        st, self._inflight = self._inflight, None
+        if st is not None:
+            self._discard(st, "abandoned")
+
+    # -- the non-speculative (serial-order) cycle ---------------------------
+
+    def _chain_ok(self, names: List[str]) -> bool:
+        if "allocate" not in names:
+            return False
+        order = [n for n in _CHAIN if n in names]
+        return list(names) == order
+
+    def _preamble(self, ssn) -> None:
+        """The run_actions head every COMMITTING session owes: express
+        reconciliation (the session is the fairness authority for every
+        outstanding optimistic bind) and the takeover recovery sweep."""
+        lane = getattr(self.cache, "express_lane", None)
+        if lane is not None:
+            from volcano_tpu.express.reconcile import reconcile_session
+
+            lane.set_tiers(ssn.tiers)
+            reconcile_session(ssn)
+        if getattr(self.cache, "fence_sweep_due", False):
+            self.cache.fence_sweep_due = False
+            takeover_recovery_sweep(ssn)
+
+    def _full_cycle(self, actions, names, tiers, info) -> object:
+        """Open + run + (caller closes) one session in strict serial
+        order — the re-run path after a discard, and every cycle whose
+        chain is outside the pipelined envelope."""
+        ssn = open_session(self.cache, tiers)
+        if not self._chain_ok(names):
+            self.stats["fallback_cycles"] += 1
+            info["mode"] = "fallback"
+            info["action_ms"] = run_actions(ssn, actions)
+            return ssn
+        self._preamble(ssn)
+        action_ms: Dict[str, float] = {}
+        t0 = time.perf_counter()
+        if "enqueue" in names:
+            get_action("enqueue").execute(ssn)
+            action_ms["enqueue"] = round(
+                (time.perf_counter() - t0) * 1e3, 3)
+        solver = getattr(ssn, "batch_allocator", None)
+        prep = solver._prepare(ssn) if solver is not None else None
+        t0 = time.perf_counter()
+        if prep is None or prep["mode"] != "rounds" \
+                or prep["staged"] is None:
+            # sub-threshold / fallback sessions: the allocate action owns
+            # its own solver ladder (serial oracle included)
+            info["mode"] = "per_action"
+            for name in names:
+                if name == "enqueue":
+                    continue
+                t1 = time.perf_counter()
+                get_action(name).execute(ssn)
+                action_ms[name] = round(
+                    (time.perf_counter() - t1) * 1e3, 3)
+            info["action_ms"] = action_ms
+            return ssn
+        if self._solve_and_apply(ssn, solver, prep, wait=None):
+            from volcano_tpu.scheduler.actions.allocate import \
+                finish_batched
+
+            finish_batched(ssn, solver)
+        else:
+            # dispatch/fetch failure: the allocate action retries through
+            # its own fallback ladder (serial host solve), which runs
+            # finish_batched itself when the retry lands batched
+            get_action("allocate").execute(ssn)
+        action_ms["allocate"] = round((time.perf_counter() - t0) * 1e3, 3)
+        if "backfill" in names:
+            t1 = time.perf_counter()
+            get_action("backfill").execute(ssn)
+            action_ms["backfill"] = round(
+                (time.perf_counter() - t1) * 1e3, 3)
+        info.setdefault("mode", "pipelined")
+        info["action_ms"] = action_ms
+        return ssn
+
+    def _solve_and_apply(self, ssn, solver, prep, wait) -> bool:
+        """Dispatch (or, with ``wait`` given, consume the speculative
+        fetch) + parse + bulk-apply one packed rounds solve. Returns
+        False when the device path failed BEFORE anything was applied."""
+        from volcano_tpu.scheduler import degrade as degrade_mod
+        from volcano_tpu.utils import devprof
+
+        try:
+            if wait is None:
+                from volcano_tpu.ops import rounds as rounds_mod
+
+                tp = time.perf_counter()
+                wait = devprof.start_fetch(rounds_mod.solve_rounds_packed(
+                    prep["spec"], prep["layout"], prep["staged"]))
+                out = wait()
+                solver.profile["pack_s"] = prep["pack_s"]
+                solver.profile["dispatch_s"] = time.perf_counter() - tp
+            else:
+                out = wait()
+            assign, meta = solver.parse_packed(out)
+        except Exception as e:
+            logger.exception("pipeline solve failed; serial fallback")
+            solver.profile["fallback"] = f"solve error: {e}"
+            degrade_mod.note_kernel_failure()
+            return False
+        degrade_mod.note_kernel_ok()
+        solver.apply_packed(ssn, prep, np.asarray(assign), meta)
+        return True
+
+    # -- speculation ---------------------------------------------------------
+
+    def _skip(self, info, reason: str) -> None:
+        skips = self.stats["spec_skips"]
+        skips[reason] = skips.get(reason, 0) + 1
+        info.setdefault("spec", f"skipped:{reason}")
+
+    def _speculate(self, actions, names, tiers, info) -> None:
+        """Open the NEXT cycle's session and dispatch its solve before
+        the current one closes. Leaves self._inflight set on success;
+        otherwise records why this cycle declined to solve ahead."""
+        if not self.spec or self.degrade.force_serial():
+            self._skip(info, "disabled")
+            return
+        if not self._chain_ok(names):
+            self._skip(info, "chain_shape")
+            return
+        lane = getattr(self.cache, "express_lane", None)
+        if lane is not None and lane.outstanding:
+            # outstanding optimistic binds: their reconcile verdicts (and
+            # any freed revert capacity) must land BEFORE the solve
+            # encodes — the committing session owns them, never this one
+            self._skip(info, "express_tokens")
+            return
+        if getattr(self.cache, "fence_sweep_due", False):
+            self._skip(info, "fence_sweep_due")
+            return
+        ssn = open_session(self.cache, tiers)
+        flips = self._staged_enqueue(ssn) if "enqueue" in names else []
+        if flips is None:
+            self._release(ssn)
+            self._skip(info, "enqueue_active")
+            return
+        # encode with the staged flips APPLIED (the encoder excludes
+        # Pending-phase jobs — encoder.py job gate), then park them until
+        # commit: the shared PodGroup objects must carry zero observable
+        # state while this session is merely speculative
+        solver = getattr(ssn, "batch_allocator", None)
+        try:
+            prep = solver._prepare(ssn) if solver is not None else None
+        finally:
+            for pg in flips:
+                pg.status.phase = objects.PodGroupPhase.PENDING
+        if prep is None or prep["mode"] != "rounds" \
+                or prep["staged"] is None:
+            self._release(ssn)
+            self._skip(info, "not_packed_rounds")
+            return
+        fingerprint = self._fingerprint(tiers)
+        try:
+            from volcano_tpu.ops import rounds as rounds_mod
+            from volcano_tpu.utils import devprof
+
+            t_dispatch = time.perf_counter()
+            dev = rounds_mod.solve_rounds_packed(
+                prep["spec"], prep["layout"], prep["staged"])
+            wait = devprof.start_fetch(dev)
+        except Exception:
+            logger.exception("speculative dispatch failed; cycle will "
+                             "run serially")
+            from volcano_tpu.scheduler import degrade as degrade_mod
+
+            degrade_mod.note_kernel_failure()
+            self._release(ssn)
+            self._skip(info, "dispatch_error")
+            return
+        self._inflight = _InFlight(ssn, names, prep, dev, wait,
+                                   fingerprint, flips, tiers, t_dispatch)
+        self.stats["spec_dispatched"] += 1
+        info.setdefault("spec", "dispatched")
+
+    def _staged_enqueue(self, ssn):
+        """Run the REAL enqueue action and record its Pending->Inqueue
+        flips. The flips land on PodGroup objects SHARED with the cache/
+        store, so the caller parks them back to Pending after the encode
+        and re-applies them only at commit — a discarded speculative
+        session must leave zero observable state. Returns the flip list
+        still APPLIED (the encode needs the admitted phase), or None when
+        a flipped job already has pending tasks — the serial order would
+        let allocate see it admitted this cycle, so the cycle must not
+        speculate (the caller reverts before declining)."""
+        PENDING = objects.PodGroupPhase.PENDING
+        before = []
+        for job in ssn.jobs.values():
+            pg = job.pod_group
+            if pg is not None and pg.status.phase == PENDING:
+                before.append((job, pg))
+        get_action("enqueue").execute(ssn)
+        flips = []
+        active = False
+        for job, pg in before:
+            if pg.status.phase == objects.PodGroupPhase.INQUEUE:
+                flips.append(pg)
+                if job.task_status_index.get(TaskStatus.PENDING):
+                    active = True
+        if active:
+            for pg in flips:
+                pg.status.phase = PENDING
+            return None
+        return flips
+
+    # -- commit / discard ----------------------------------------------------
+
+    def _commit(self, st: _InFlight, info) -> Optional[object]:
+        """The fingerprint held: this speculative session IS the cycle.
+        Returns the session, or None when the fetch failed (the caller
+        re-runs the cycle serially; nothing was applied)."""
+        ssn = st.ssn
+        solver = ssn.batch_allocator
+        t0 = time.perf_counter()
+        self._preamble(ssn)  # no outstanding tokens by fingerprint;
+        #                      reconcile still bumps the lane's session seq
+        for pg in st.flips:
+            pg.status.phase = objects.PodGroupPhase.INQUEUE
+        # apply-time re-check, the sim auditor's pipeline_no_stale_commit
+        # witness: stale_commits counts stages whose fingerprint mismatched
+        # HERE, past the cycle-entry check — it must stay 0 (nothing on
+        # this thread may move state between the two probes), and if it
+        # ever fires the stage is still discarded, never applied
+        ok, reason = self._check(st, st.tiers)
+        if not ok:
+            self.stats["stale_commits"] += 1
+            self._note_discard(f"stale_at_apply:{reason}")
+            self.stats["spec_reruns"] += 1
+            info["spec"] = f"discarded:stale_at_apply:{reason}"
+            self._revert_flips(st)
+            from volcano_tpu.utils import devprof
+
+            devprof.discard(st.dev)
+            self._release(ssn)
+            return None
+        t_wait = time.perf_counter()
+        overlap_s = t_wait - st.t_dispatch
+        if not self._solve_and_apply(ssn, solver, st.prep, wait=st.wait):
+            # fetch failed: treat exactly like a discard — nothing from
+            # this stage was applied — and let the caller re-run
+            self._note_discard("kernel_error")
+            self.stats["spec_reruns"] += 1
+            info["spec"] = "discarded:kernel_error"
+            self._revert_flips(st)
+            self._release(ssn)
+            return None
+        from volcano_tpu.scheduler.actions.allocate import finish_batched
+
+        finish_batched(ssn, solver)
+        action_ms = {"allocate": round(
+            (time.perf_counter() - t0) * 1e3, 3)}
+        if "backfill" in st.names:
+            t1 = time.perf_counter()
+            get_action("backfill").execute(ssn)
+            action_ms["backfill"] = round(
+                (time.perf_counter() - t1) * 1e3, 3)
+        self.stats["spec_applied"] += 1
+        metrics.observe_pipeline_overlap(overlap_s)
+        info["mode"] = "speculative"
+        info["overlap_ms"] = round(overlap_s * 1e3, 3)
+        info["spec_applied"] = True
+        info["action_ms"] = action_ms
+        return ssn
+
+    def _revert_flips(self, st: _InFlight) -> None:
+        for pg in st.flips:
+            pg.status.phase = objects.PodGroupPhase.PENDING
+
+    def _note_discard(self, reason: str) -> None:
+        self.stats["spec_discarded"] += 1
+        discards = self.stats["spec_discards"]
+        discards[reason] = discards.get(reason, 0) + 1
+        metrics.register_pipeline_spec_discard(reason)
+
+    def _discard(self, st: _InFlight, reason: str) -> None:
+        """An invalidated speculative stage: never fetched into session
+        state, never applied. The device result is dropped untouched and
+        the early-opened session is released without close-side effects
+        (it made none — enqueue flips were staged-and-reverted and no
+        statement ever committed)."""
+        from volcano_tpu.utils import devprof
+
+        self._note_discard(reason)
+        devprof.discard(st.dev)
+        self._release(st.ssn)
+
+    @staticmethod
+    def _release(ssn) -> None:
+        """Drop a session that never committed anything: clear the same
+        references close_session clears, WITHOUT plugin close hooks,
+        status writebacks, or the job updater — a speculative session
+        that did not commit must be invisible."""
+        ssn.jobs = {}
+        ssn.nodes = {}
+        ssn.node_axis = None
+        ssn.plugins = {}
+        ssn.event_handlers = []
+        ssn.job_order_fns = {}
+        ssn.namespace_order_fns = {}
+        ssn.queue_order_fns = {}

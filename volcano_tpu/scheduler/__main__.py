@@ -47,6 +47,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="enable the event-driven express lane: eligible "
                          "interactive arrivals place between periodic "
                          "sessions (volcano_tpu/express)")
+    ap.add_argument("--pipeline", action="store_true", default=False,
+                    help="enable the continuous scheduling pipeline: "
+                         "double-buffered sessions with speculative "
+                         "solve-ahead (volcano_tpu/pipeline); "
+                         "VOLCANO_TPU_PIPELINE=0 forces the serial loop")
     ap.add_argument("--leader-elect", action="store_true", default=False)
     ap.add_argument("--lock-object-namespace", default="volcano-system")
     ap.add_argument("--leader-elect-identity", default="",
@@ -228,7 +233,7 @@ def run_remote_scheduler(args) -> int:
     cache.run()
     scheduler = Scheduler(
         cache, scheduler_conf="", schedule_period=args.schedule_period,
-        express=args.express)
+        express=args.express, pipeline=args.pipeline)
     if args.scheduler_conf:
         scheduler.conf_path = args.scheduler_conf
 

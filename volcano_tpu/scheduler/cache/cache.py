@@ -351,6 +351,21 @@ class SchedulerCache:
         # a deposed leader's fenced mid-chain abort left in the store
         # (framework.run_actions consumes this flag)
         self.fence_sweep_due = False
+        # continuous pipeline (volcano_tpu/pipeline): when armed, every
+        # snapshot() alternates the keeper's double buffer so consecutive
+        # sessions never share clone objects (cycle N's close can still
+        # read its snapshot while cycle N+1's is already solving)
+        self._pipeline_swap = False
+        # self-echo window (update_job_status): the in-process store
+        # dispatches watch callbacks synchronously with the SAME object the
+        # writer handed it, so the close-time PodGroup status writeback
+        # comes straight back through update_pod_group_from_watch. The
+        # mutation already happened on the shared object before the write —
+        # marking the job again only churns the dirty-set (and, in pipeline
+        # mode, spuriously invalidates every speculative solve-ahead).
+        # RemoteStore echoes deserialize to a different object and keep the
+        # full mark path.
+        self._expect_pg_echo = None
 
     def set_fence_epoch(self, epoch) -> None:
         """Stamp this cache's effector write-path with a leadership epoch
@@ -491,10 +506,37 @@ class SchedulerCache:
     def update_pod_from_watch(self, old_pod: objects.Pod, new_pod: objects.Pod) -> None:
         self.flush_mirror()  # see add_pod
         with self._lock:
+            if old_pod is new_pod and self._neutral_pod_echo(new_pod):
+                # a same-object write (in-process store dispatches the
+                # writer's object) whose scheduling-relevant derived state
+                # matches the cached task: a condition/metadata-only echo
+                # — typically our own close-time FailedScheduling
+                # writeback. Resyncing would rebuild an equal TaskInfo and
+                # re-mark its job/node for nothing (in pipeline mode that
+                # mark spuriously discards the speculative solve-ahead).
+                # Bind confirmations and kubelet phase flips change the
+                # derived status and keep the full resync path.
+                return
             self._delete_pod_locked(old_pod)
             if not self._responsible_for(new_pod):
                 return
             self._add_task(new_task_info(new_pod))
+
+    def _neutral_pod_echo(self, pod: objects.Pod) -> bool:
+        """True when the cached task for ``pod`` already matches the
+        pod-derived scheduling state (status + node), so a same-object
+        update carries nothing the scheduler can observe. Requests are not
+        compared: the pod IS the cached task's pod object, and spec
+        resources deriving resreq are immutable post-admission."""
+        if not self._responsible_for(pod):
+            return False
+        pi = new_task_info(pod)
+        job = self.jobs.get(pi.job)
+        task = job.tasks.get(pi.uid) if job is not None else None
+        if task is None or task.pod is not pod:
+            return False
+        return (task.status == pi.status
+                and (task.node_name or "") == (pi.node_name or ""))
 
     def _delete_pod_locked(self, pod: objects.Pod) -> None:
         pi = new_task_info(pod)
@@ -540,6 +582,17 @@ class SchedulerCache:
     def add_pod_group(self, pg: objects.PodGroup) -> None:
         with self._lock:
             job_id = pod_group_job_id(pg)
+            job = self.jobs.get(job_id)
+            if pg is self._expect_pg_echo and job is not None \
+                    and job.pod_group is pg:
+                # our own status writeback echoing back as the identical
+                # object: the cache (and every snapshot clone, which
+                # shares pod_group) already sees the mutation — re-marking
+                # would only dirty the keeper for a value-neutral event.
+                # set_pod_group still runs: it re-reads derived fields
+                # from the same object (idempotent, cheap).
+                job.set_pod_group(pg)
+                return
             self.snap_keeper.mark_job(job_id)
             if job_id not in self.jobs:
                 self.jobs[job_id] = JobInfo(job_id)
@@ -575,6 +628,11 @@ class SchedulerCache:
                 # updates of an existing queue don't (QueueInfos are
                 # re-cloned fresh every snapshot regardless)
                 self.snap_keeper.invalidate()
+            else:
+                # spec updates (weight, capability) re-derive fresh next
+                # snapshot, but a speculative solve sealed under the old
+                # policy must be invalidated (snapkeeper.mark_meta)
+                self.snap_keeper.mark_meta()
             self.queues[queue.metadata.name] = QueueInfo(queue)
 
     def update_queue_from_watch(self, old: objects.Queue, new: objects.Queue) -> None:
@@ -614,6 +672,10 @@ class SchedulerCache:
             ns = quota.metadata.namespace
             coll = self.namespace_collection.setdefault(ns, NamespaceCollection(ns))
             coll.update(quota)
+            # namespace weights re-derive fresh each snapshot; the epoch
+            # bump invalidates any speculative solve sealed under the
+            # old weights (snapkeeper.mark_meta)
+            self.snap_keeper.mark_meta()
 
     def update_resource_quota_from_watch(self, old, new) -> None:
         self.add_resource_quota(new)
@@ -625,6 +687,7 @@ class SchedulerCache:
                 coll.delete(quota)
                 if coll.empty():
                     del self.namespace_collection[quota.metadata.namespace]
+                self.snap_keeper.mark_meta()
 
     # -- pdb handlers ------------------------------------------------------
 
@@ -834,7 +897,15 @@ class SchedulerCache:
 
     def update_job_status(self, job: JobInfo, update_pg: bool) -> JobInfo:
         if update_pg and self.status_updater is not None and job.pod_group is not None:
-            self.status_updater.update_pod_group(job.pod_group)
+            # the synchronous in-process echo of this write is value-
+            # neutral (the status swap already landed on the shared
+            # object); the identity window lets add_pod_group recognize it
+            # and skip the spurious keeper mark
+            self._expect_pg_echo = job.pod_group
+            try:
+                self.status_updater.update_pod_group(job.pod_group)
+            finally:
+                self._expect_pg_echo = None
         self.record_job_status_event(job)
         return job
 
@@ -1013,7 +1084,38 @@ class SchedulerCache:
         (snapkeeper.py): only jobs/nodes whose cache twins or handed-out
         clones moved since the last session are re-cloned; the first call
         (and any keeper invalidation) is the wholesale rebuild of
-        cache.go:713-798."""
+        cache.go:713-798. In pipeline mode the keeper's buffer pair is
+        swapped first — the flush lands on the PREVIOUS session's buffer
+        (whose objects the flush mirrored), then the other buffer is
+        delta-opened for the new session."""
         self.flush_mirror()
         with self._lock:
+            if self._pipeline_swap:
+                self.snap_keeper.swap()
             return self.snap_keeper.snapshot(self)
+
+    # -- continuous pipeline support (volcano_tpu/pipeline) ----------------
+
+    def enable_pipeline(self) -> None:
+        """Arm the double-buffered snapshot path (idempotent). Serial
+        callers are untouched until this is called; VOLCANO_TPU_PIPELINE=0
+        keeps the single-buffer oracle by never calling it."""
+        self.snap_keeper.enable_pair()
+        self._pipeline_swap = True
+
+    def pipeline_fingerprint(self) -> tuple:
+        """The delta fingerprint a speculative solve-ahead seals at
+        dispatch and re-checks before apply: the keeper's dirty epoch
+        (every watch/effector mark bumps it), the keeper generation
+        (wholesale invalidations), the lease fence epoch (a takeover must
+        kill in-flight speculation), and the summed cache-node accounting
+        generation (belt-and-braces for any mirror mutation a mark path
+        missed). Any component moving between seal and check means state
+        the speculative snapshot did not see — the stage is discarded."""
+        keeper = self.snap_keeper
+        with self._lock:
+            acct = 0
+            for node in self.nodes.values():
+                acct += node._acct_gen
+            return (keeper.dirty_epoch, keeper.generation,
+                    self.fence_epoch, acct, len(self.nodes))

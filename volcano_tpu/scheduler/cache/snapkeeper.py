@@ -77,6 +77,27 @@ class DirtyShadow:
         self.generation = 0
 
 
+class _SnapshotBuffer:
+    """One snapshot buffer's private state (the pipeline's double-buffer
+    half). The keeper's live buffer lives directly on the keeper (the
+    pre-pipeline layout, untouched for single-buffer users); ``swap()``
+    exchanges the keeper's live fields with a parked ``_SnapshotBuffer``
+    so two consecutive sessions never share clone objects."""
+
+    __slots__ = ("jobs", "nodes", "job_vers", "node_gens",
+                 "dirty_jobs", "dirty_nodes", "axis", "built_generation")
+
+    def __init__(self):
+        self.jobs: Dict[str, object] = {}
+        self.nodes: Dict[str, object] = {}
+        self.job_vers: Dict[str, int] = {}
+        self.node_gens: Dict[str, int] = {}
+        self.dirty_jobs: Set[str] = set()
+        self.dirty_nodes: Set[str] = set()
+        self.axis = None
+        self.built_generation = -1
+
+
 class SnapshotKeeper:
     def __init__(self):
         self.enabled = not os.environ.get("VOLCANO_TPU_WHOLESALE_SNAPSHOT")
@@ -90,11 +111,53 @@ class SnapshotKeeper:
         self.generation = 0       # bump => next snapshot fully rebuilds
         self._built_generation = -1
         self.axis = None
+        # delta fingerprint for the pipeline's speculative solve-ahead:
+        # every mark/invalidate bumps it, so (dirty_epoch, generation)
+        # captured at dispatch and re-checked before apply detects ANY
+        # state movement the speculative snapshot did not see
+        self.dirty_epoch = 0
+        # pipeline double-buffer: when armed (enable_pair), marks land in
+        # BOTH buffers' dirty sets and swap() alternates which buffer the
+        # next snapshot builds — session N and session N+1 then never
+        # share clone objects, so N's close can still read its snapshot
+        # while N+1's is already open
+        self._standby: "_SnapshotBuffer | None" = None
         self.stats = {"rebuilds": 0, "incremental": 0,
                       "reused_jobs": 0, "cloned_jobs": 0,
                       "reused_nodes": 0, "cloned_nodes": 0,
                       "axis_rebuilds": 0, "axis_rows_refreshed": 0,
-                      "evict_marks": 0}
+                      "evict_marks": 0, "swaps": 0}
+
+    # -- pipeline buffer pair ------------------------------------------------
+
+    @property
+    def pair_enabled(self) -> bool:
+        return self._standby is not None
+
+    def enable_pair(self) -> None:
+        """Arm the double buffer (idempotent). The standby starts with
+        built_generation=-1, so its first build is a wholesale rebuild —
+        after that both buffers delta-maintain independently."""
+        if self._standby is None:
+            self._standby = _SnapshotBuffer()
+
+    def swap(self) -> None:
+        """Exchange the live buffer with the standby (caller holds the
+        cache lock). No-op until enable_pair()."""
+        sb = self._standby
+        if sb is None:
+            return
+        (self.jobs, sb.jobs) = (sb.jobs, self.jobs)
+        (self.nodes, sb.nodes) = (sb.nodes, self.nodes)
+        (self.job_vers, sb.job_vers) = (sb.job_vers, self.job_vers)
+        (self.node_gens, sb.node_gens) = (sb.node_gens, self.node_gens)
+        (self.dirty_jobs, sb.dirty_jobs) = (sb.dirty_jobs, self.dirty_jobs)
+        (self.dirty_nodes, sb.dirty_nodes) = (
+            sb.dirty_nodes, self.dirty_nodes)
+        (self.axis, sb.axis) = (sb.axis, self.axis)
+        (self._built_generation, sb.built_generation) = (
+            sb.built_generation, self._built_generation)
+        self.stats["swaps"] += 1
 
     # -- marks (called under the cache lock) --------------------------------
 
@@ -114,12 +177,18 @@ class SnapshotKeeper:
     def mark_job(self, uid: str) -> None:
         if uid:
             self.dirty_jobs.add(uid)
+            self.dirty_epoch += 1
+            if self._standby is not None:
+                self._standby.dirty_jobs.add(uid)
             for sh in self.shadows:
                 sh.dirty_jobs.add(uid)
 
     def mark_node(self, name: str) -> None:
         if name:
             self.dirty_nodes.add(name)
+            self.dirty_epoch += 1
+            if self._standby is not None:
+                self._standby.dirty_nodes.add(name)
             for sh in self.shadows:
                 sh.dirty_nodes.add(name)
 
@@ -132,8 +201,19 @@ class SnapshotKeeper:
         self.mark_node(node_name)
         self.stats["evict_marks"] += 1
 
+    def mark_meta(self) -> None:
+        """A policy-level delta the per-object dirty-sets don't model —
+        an existing queue's spec update, a namespace quota change.
+        QueueInfos and namespace weights are re-derived fresh every
+        snapshot, so no clone needs invalidating; but the pipeline's
+        speculative solve-ahead read the OLD policy, so the fingerprint
+        epoch must move or a sealed stage could commit against a weight
+        the serial order would not have used."""
+        self.dirty_epoch += 1
+
     def invalidate(self) -> None:
         self.generation += 1
+        self.dirty_epoch += 1
         for sh in self.shadows:
             sh.generation += 1
 
@@ -141,13 +221,21 @@ class SnapshotKeeper:
 
     def sync_job(self, uid: str, version: int) -> None:
         """Declare the snapshot job in sync with the cache at `version`
-        (the flush just mirrored the session's bulk placements)."""
+        (the flush just mirrored the session's bulk placements). The sync
+        is valid only for the LIVE buffer — its clones ARE the session
+        objects the flush mirrored; the standby buffer's clone of the same
+        job predates the placement and must re-clone from the flushed
+        cache twin at its next turn, so it is dirtied instead."""
         if uid in self.job_vers:
             self.job_vers[uid] = version
+        if self._standby is not None:
+            self._standby.dirty_jobs.add(uid)
 
     def sync_node(self, name: str, gen: int) -> None:
         if name in self.node_gens:
             self.node_gens[name] = gen
+        if self._standby is not None:
+            self._standby.dirty_nodes.add(name)
 
     # -- snapshot -----------------------------------------------------------
 

@@ -18,6 +18,10 @@ failing dependency; see docs/DESIGN.md §15):
 - ``serial_host_solve``    — the kernel breaker is OPEN: persistent
   device/compile failure, every action goes serial preemptively instead
   of paying a doomed dispatch + fallback per action;
+- ``pipeline_disabled``    — the continuous pipeline's breaker is open
+  (repeated pipelined-cycle ERRORS — speculation discards are normal
+  churn and never trip it): the scheduler loop falls back to the serial
+  run_once cycle until the half-open probe passes;
 - ``express_disabled``     — the express lane's breaker is open (repeated
   batch errors) or the lane was parked by lease loss: arrivals fall
   through to full sessions;
@@ -46,8 +50,8 @@ from typing import Dict, Optional
 from volcano_tpu.scheduler import metrics
 from volcano_tpu.utils import clock
 
-RUNGS = ("per_action_fallback", "serial_host_solve", "express_disabled",
-         "session_skip")
+RUNGS = ("per_action_fallback", "pipeline_disabled", "serial_host_solve",
+         "express_disabled", "session_skip")
 
 
 class Backoff:
@@ -159,6 +163,7 @@ class DegradeLadder:
     def __init__(self, store_threshold: int = 3, store_cooldown_s: float = 15.0,
                  kernel_threshold: int = 3, kernel_cooldown_s: float = 60.0,
                  express_threshold: int = 3, express_cooldown_s: float = 30.0,
+                 pipeline_threshold: int = 3, pipeline_cooldown_s: float = 30.0,
                  max_session_skips: int = 5):
         self.store = CircuitBreaker("store", store_threshold,
                                     store_cooldown_s)
@@ -166,6 +171,8 @@ class DegradeLadder:
                                      kernel_cooldown_s)
         self.express = CircuitBreaker("express", express_threshold,
                                       express_cooldown_s)
+        self.pipeline = CircuitBreaker("pipeline", pipeline_threshold,
+                                       pipeline_cooldown_s)
         self.max_session_skips = int(max_session_skips)
         self._skips = 0
         self.counters = {"sessions_skipped": 0, "forced_sessions": 0,
@@ -201,6 +208,16 @@ class DegradeLadder:
         self.express.record_success()
         self._publish()
 
+    def note_pipeline_error(self) -> None:
+        """A pipelined cycle CRASHED (not a speculation discard — those
+        are the design working as intended and are merely counted)."""
+        self.pipeline.record_failure()
+        self._publish()
+
+    def note_pipeline_ok(self) -> None:
+        self.pipeline.record_success()
+        self._publish()
+
     # -- the gates callers consult ------------------------------------------
 
     def force_serial(self) -> bool:
@@ -213,6 +230,13 @@ class DegradeLadder:
 
     def express_allowed(self) -> bool:
         return self.express.allow()
+
+    def pipeline_allowed(self) -> bool:
+        """True while the pipelined loop may run; False = the
+        pipeline_disabled rung — the scheduler runs the serial run_once
+        cycle (byte-for-byte the VOLCANO_TPU_PIPELINE=0 oracle) until the
+        half-open probe lets one pipelined cycle prove itself again."""
+        return self.pipeline.allow()
 
     def should_skip_session(self) -> bool:
         """True while the store breaker is open AND the staleness budget
@@ -240,6 +264,8 @@ class DegradeLadder:
             return "express_disabled"
         if self.kernel.state != CircuitBreaker.CLOSED:
             return "serial_host_solve"
+        if self.pipeline.state != CircuitBreaker.CLOSED:
+            return "pipeline_disabled"
         return ""
 
     def _publish(self) -> None:
@@ -251,13 +277,17 @@ class DegradeLadder:
             self.express.state != CircuitBreaker.CLOSED)
         metrics.set_degraded_mode(
             "session_skip", self.store.state != CircuitBreaker.CLOSED)
+        metrics.set_degraded_mode(
+            "pipeline_disabled",
+            self.pipeline.state != CircuitBreaker.CLOSED)
 
     def stats(self) -> Dict[str, object]:
         return {
             "rung": self.rung(),
             "counters": dict(self.counters),
             "breakers": {b.name: {"state": b.state, **b.stats}
-                         for b in (self.store, self.kernel, self.express)},
+                         for b in (self.store, self.kernel, self.express,
+                                   self.pipeline)},
         }
 
 

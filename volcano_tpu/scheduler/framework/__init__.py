@@ -16,4 +16,5 @@ from volcano_tpu.scheduler.framework.framework import (
     open_session,
     close_session,
     run_actions,
+    takeover_recovery_sweep,
 )

@@ -129,6 +129,20 @@ class FailoverScheduler:
         self.scheduler = scheduler
         self.store = store
         identity = identity or f"{socket.gethostname()}-{os.getpid()}"
+        if getattr(scheduler, "_pipeline", False):
+            # a standby for a PIPELINED scheduler must keep BOTH halves
+            # of the snapshot buffer pair warm: with the pair armed, each
+            # follow_once alternates buffers, so the first led cycle (and
+            # its first solve-ahead) both open incrementally — enabling
+            # the pair only at takeover would pay a wholesale rebuild for
+            # the second buffer right inside the takeover bound
+            try:
+                from volcano_tpu.pipeline import pipeline_enabled
+
+                if pipeline_enabled():
+                    scheduler.cache.enable_pipeline()
+            except Exception:  # pragma: no cover - jax-free host
+                pass
         self.standby = WarmStandby(scheduler.cache, follow_period)
         self.elector = LeaderElector(
             ResourceLock(store, lock_namespace, lock_name, identity),

@@ -147,6 +147,24 @@ class Registry:
         self.degraded_mode = Gauge(
             f"{_NAMESPACE}_degraded_mode",
             "Degradation-ladder rung activity (1 = active)", ("rung",))
+        # continuous pipeline (volcano_tpu/pipeline): sustained throughput
+        # (the headline the pipelined loop binds on), per-reason
+        # speculation discards (an invalidated stage is NEVER applied —
+        # the counter is the proof the discard path ran), and the host
+        # wall overlapped with an in-flight speculative device solve
+        self.pipeline_sessions_per_sec = Gauge(
+            f"{_NAMESPACE}_pipeline_sessions_per_sec",
+            "Sustained committed sessions per wall second through the "
+            "pipelined loop")
+        self.pipeline_spec_discards = Counter(
+            f"{_NAMESPACE}_pipeline_spec_discards_total",
+            "Speculative solve-ahead stages discarded before apply, "
+            "by invalidation reason", ("reason",))
+        self.pipeline_overlap = Histogram(
+            f"{_NAMESPACE}_pipeline_overlap_seconds",
+            "Host work overlapped with an in-flight speculative device "
+            "solve, per committed cycle",
+            [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0])
         # instantaneous cluster levels (set each cycle; the sim harness and
         # the scheduler loop both publish through these)
         self.pending_pods = Gauge(
@@ -266,6 +284,18 @@ def set_degraded_mode(rung: str, active: bool) -> None:
     registry().degraded_mode.set(1.0 if active else 0.0, (rung,))
 
 
+def set_pipeline_sessions_per_sec(v: float) -> None:
+    registry().pipeline_sessions_per_sec.set(v)
+
+
+def register_pipeline_spec_discard(reason: str, n: int = 1) -> None:
+    registry().pipeline_spec_discards.inc((reason,), n)
+
+
+def observe_pipeline_overlap(seconds: float) -> None:
+    registry().pipeline_overlap.observe(seconds)
+
+
 # -- exposition -------------------------------------------------------------
 
 
@@ -274,7 +304,7 @@ def render() -> str:
     r = registry()
     lines: List[str] = []
     for h in (r.e2e_latency, r.plugin_latency, r.action_latency,
-              r.task_latency, r.express_latency):
+              r.task_latency, r.express_latency, r.pipeline_overlap):
         lines.append(f"# HELP {h.name} {h.help}")
         lines.append(f"# TYPE {h.name} histogram")
         for labels, (counts, total, n) in h.snapshot().items():
@@ -295,6 +325,7 @@ def render() -> str:
         r.unschedule_task_count, r.unschedule_job_count, r.job_retry_counts,
         r.express_placements, r.express_reverted, r.express_deferred,
         r.leader_transitions, r.fenced_writes_rejected,
+        r.pipeline_spec_discards,
     ):
         lines.append(f"# HELP {c.name} {c.help}")
         lines.append(f"# TYPE {c.name} counter")
@@ -304,7 +335,7 @@ def render() -> str:
                 suffix = f"{{{label_str}}}" if label_str else ""
                 lines.append(f"{c.name}{suffix} {v}")
     for g in (r.pending_pods, r.queue_depth, r.sessions_run,
-              r.degraded_mode):
+              r.degraded_mode, r.pipeline_sessions_per_sec):
         lines.append(f"# HELP {g.name} {g.help}")
         lines.append(f"# TYPE {g.name} gauge")
         with g._lock:

@@ -122,6 +122,7 @@ class Scheduler:
         conf_path: Optional[str] = None,
         mesh=None,
         express: bool = False,
+        pipeline: bool = False,
     ):
         self.cache = cache
         self.scheduler_conf = scheduler_conf or DEFAULT_SCHEDULER_CONF
@@ -141,6 +142,17 @@ class Scheduler:
         # inter-cycle wait, and every full session reconciles
         self.express_lane = None
         self._express = express
+        # continuous pipeline (volcano_tpu/pipeline): double-buffered
+        # sessions with speculative solve-ahead — the sustained-throughput
+        # loop. VOLCANO_TPU_PIPELINE=0 keeps the serial run_once cycle
+        # (the byte-for-byte oracle) regardless of this flag, and the
+        # degrade ladder's pipeline_disabled rung falls back to it live.
+        self._pipeline = pipeline
+        self.pipeline_driver = None
+        # conf-parse cache: the pipeline's speculation fingerprint keys on
+        # the tiers OBJECT identity, so an unchanged conf text must hand
+        # back the same parsed objects cycle over cycle
+        self._conf_cache: Optional[Tuple[str, List, List[conf.Tier]]] = None
         # fault-degradation policy (scheduler/degrade.py): the process
         # default so the solver's kernel-failure hooks and this loop's
         # session gate share one ladder; embedders report remote-store
@@ -175,6 +187,19 @@ class Scheduler:
             # re-acquired leadership (or plain restart): the lane resumes
             # from wherever the last term parked it
             self.express_lane.unpark()
+        if self._pipeline and self.pipeline_driver is None:
+            try:
+                from volcano_tpu.pipeline import (
+                    PipelineDriver, pipeline_enabled)
+
+                if pipeline_enabled():
+                    self.pipeline_driver = PipelineDriver(
+                        self.cache, self._cycle_policy,
+                        degrade=self.degrade)
+            except Exception:  # pragma: no cover - jax-free host
+                logger.exception(
+                    "pipeline unavailable; running the serial loop")
+                self._pipeline = False
         # fresh Event per generation: if stop()'s bounded join left a
         # previous loop thread mid-run_once, that zombie still sees ITS
         # (set) event and exits; clearing a shared event would revive it
@@ -185,6 +210,11 @@ class Scheduler:
         self._thread.start()
 
     def stop(self, stop_cache: bool = True) -> None:
+        if self.pipeline_driver is not None:
+            # a stopping (possibly deposed) scheduler must not leave a
+            # speculative solve pending — its result is discarded, never
+            # applied; a successor term starts from the store's truth
+            self.pipeline_driver.abandon()
         if self.express_lane is not None:
             # failover hygiene: a stopping (possibly deposed) scheduler
             # must not keep optimistically binding between sessions; the
@@ -219,7 +249,11 @@ class Scheduler:
                     self._inter_cycle_wait(stop, self.schedule_period)
                     continue
                 try:
-                    self.run_once()
+                    if self.pipeline_driver is not None \
+                            and self.degrade.pipeline_allowed():
+                        self.run_once_pipelined()
+                    else:
+                        self.run_once()
                     self.degrade.note_store_ok()
                 except Exception as e:
                     from volcano_tpu.store.remote import RemoteStoreError
@@ -272,8 +306,16 @@ class Scheduler:
                 logger.error(
                     "failed to read scheduler conf %s, using configured "
                     "default: %s", self.conf_path, e)
+        cached = self._conf_cache
+        if cached is not None and cached[0] == conf_str:
+            # unchanged text: reuse the parsed objects — semantics are
+            # identical (the parse is deterministic) and the pipeline's
+            # speculation fingerprint needs stable tiers identity
+            self.actions, self.tiers = cached[1], cached[2]
+            return
         try:
             self.actions, self.tiers = load_scheduler_conf(conf_str)
+            self._conf_cache = (conf_str, self.actions, self.tiers)
         except Exception as e:
             if self.actions:
                 logger.error(
@@ -284,6 +326,24 @@ class Scheduler:
                     "using default: %s", e)
                 self.actions, self.tiers = load_scheduler_conf(
                     DEFAULT_SCHEDULER_CONF)
+
+    def _cycle_policy(self):
+        """PipelineDriver's per-cycle policy source: hot-reloads the conf
+        (cached on unchanged text so the tiers object — and therefore the
+        speculation fingerprint — is stable across steady-state cycles)."""
+        self.load_conf()
+        return self.actions, self.tiers
+
+    def run_once_pipelined(self) -> None:
+        """One pipelined cycle: commit (or discard+re-run) the in-flight
+        speculative session and leave the next cycle's solve dispatched.
+        The serial run_once stays byte-for-byte available behind
+        VOLCANO_TPU_PIPELINE=0 and the pipeline_disabled degrade rung."""
+        start = time.perf_counter()
+        info = self.pipeline_driver.run_cycle()
+        for name, ms in (info.get("action_ms") or {}).items():
+            metrics.update_action_duration(name, ms / 1e3)
+        metrics.update_e2e_duration(time.perf_counter() - start)
 
     def run_once(self) -> None:
         start = time.perf_counter()

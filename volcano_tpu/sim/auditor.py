@@ -37,6 +37,17 @@ the metrics registry against each other:
                           rules above running in the same audit pass —
                           express placements go through the same store/
                           cache state they check;
+- ``pipeline_no_stale_commit`` — (pipeline scenarios) an invalidated
+                          speculative solve-ahead is NEVER applied: the
+                          apply-time fingerprint re-check fired zero
+                          times, the dispatch ledger balances (applied +
+                          discarded + in-flight == dispatched) across
+                          every driver generation, every non-abandoned
+                          discard re-ran serially, and while express
+                          tokens are outstanding any in-flight stage has
+                          sealed a stale lane epoch (already doomed to
+                          discard) — the express_reconciliation contract
+                          extended over pipelined sessions;
 - ``ha_fencing``        — (HA scenarios) split-brain accounting balances:
                           no write stamped with a stale lease epoch ever
                           lands (``stale_binds_landed == 0`` — the
@@ -123,6 +134,7 @@ class Auditor:
         found.extend(self._check_mirrors())
         found.extend(self._check_event_consistency())
         found.extend(self._check_express())
+        found.extend(self._check_pipeline())
         if getattr(self.sim, "ha_enabled", False):
             found.extend(self._check_ha_fencing())
             found.extend(self._check_ha_takeover())
@@ -342,6 +354,72 @@ class Auditor:
                     "express_reconciliation", task_key,
                     f"reverted express bind still resident on {node_name}",
                     {"job": job_uid, "node": node_name}))
+        return out
+
+    def _check_pipeline(self) -> List[Violation]:
+        """pipeline_no_stale_commit: an invalidated speculative stage is
+        NEVER applied. Witnesses, across every driver generation the run
+        created (restarts/takeovers fold retired stats):
+
+        - the apply-time fingerprint re-check never caught a stale stage
+          (``stale_commits == 0`` — nothing may move state between the
+          cycle-entry check and the apply);
+        - dispatch accounting balances: every solve-ahead is applied,
+          discarded, or still in flight — none unaccounted;
+        - every non-abandoned discard re-ran its cycle serially (the
+          discard counter matches the re-run counter);
+        - express extension (express_reconciliation across pipelined
+          sessions): while tokens are outstanding, any in-flight
+          speculation must have sealed a DIFFERENT lane commit epoch —
+          i.e. it is already doomed to discard, so the session that
+          reconciles those tokens can never be the sealed one."""
+        out: List[Violation] = []
+        drv = getattr(self.sim, "pipeline_driver", None)
+        if drv is None and not getattr(
+                self.sim, "_pipeline_stats_total", None):
+            return out
+        stats = self.sim.pipeline_stats_combined()
+        inflight = 1 if (drv is not None
+                         and drv._inflight is not None) else 0
+        if stats.get("stale_commits", 0):
+            out.append(Violation(
+                "pipeline_no_stale_commit", "stale-at-apply",
+                f"{stats['stale_commits']} speculative stages reached the "
+                f"apply-time re-check with a moved fingerprint",
+                {"stats": stats}))
+        settled = stats.get("spec_applied", 0) + stats.get(
+            "spec_discarded", 0)
+        if settled + inflight != stats.get("spec_dispatched", 0):
+            out.append(Violation(
+                "pipeline_no_stale_commit", "dispatch-ledger",
+                f"{stats.get('spec_dispatched', 0)} solve-aheads "
+                f"dispatched vs {settled} settled + {inflight} in flight "
+                f"— a stage escaped the apply-or-discard ledger",
+                {"stats": stats}))
+        discards = stats.get("spec_discards", {}) or {}
+        non_abandoned = sum(n for reason, n in sorted(discards.items())
+                            if reason != "abandoned")
+        if non_abandoned != stats.get("spec_reruns", 0):
+            out.append(Violation(
+                "pipeline_no_stale_commit", "rerun-ledger",
+                f"{non_abandoned} non-abandoned discards vs "
+                f"{stats.get('spec_reruns', 0)} serial re-runs — a "
+                f"discarded cycle was not re-run (or re-ran twice)",
+                {"discards": dict(sorted(discards.items())),
+                 "stats": stats}))
+        lane = getattr(self.sim, "express_lane", None)
+        if (lane is not None and drv is not None
+                and drv._inflight is not None and lane.outstanding):
+            sealed_epoch = drv._inflight.fingerprint[1]
+            if sealed_epoch == lane.commit_epoch:
+                out.append(Violation(
+                    "express_reconciliation", "pipelined-seal",
+                    "speculative stage sealed the CURRENT lane commit "
+                    "epoch while express tokens are outstanding — it "
+                    "could commit and bypass their reconcile verdicts",
+                    {"sealed_epoch": sealed_epoch,
+                     "commit_epoch": lane.commit_epoch,
+                     "outstanding": sorted(lane.outstanding)[:20]}))
         return out
 
     def _check_ha_fencing(self) -> List[Violation]:

@@ -149,6 +149,12 @@ class SimCluster:
         self._task_wait_s: List[float] = []
         self.express_lane = None
         self._express_ms: List[float] = []
+        # continuous pipeline (scenario scheduler.pipeline: true): the
+        # session slice drives PipelineDriver.run_cycle instead of the
+        # serial open->actions->close; stats fold across driver
+        # generations (restarts/takeovers) for the auditor
+        self.pipeline_driver = None
+        self._pipeline_stats_total: Dict = {}
         # -- HA failover state (cfg["ha"]["enabled"]): a fenced active
         # leader plus a warm standby cache following the same store; chaos
         # deposes the leader (mid-defer / mid-chain / mid-express) and the
@@ -244,6 +250,52 @@ class SimCluster:
                 self.express_lane = ExpressLane(self.cache)
             else:
                 self.express_lane.attach(self.cache)
+        self._rebuild_pipeline_driver()
+
+    def _rebuild_pipeline_driver(self) -> None:
+        """(Re)build the continuous-pipeline driver on the CURRENT cache
+        (scenario scheduler.pipeline: true). An old driver's in-flight
+        speculation dies with its term/process — abandoned, never applied
+        — and its stats fold into the run totals so the auditor's
+        accounting spans every driver generation."""
+        old = getattr(self, "pipeline_driver", None)
+        if old is not None:
+            old.abandon()
+            self._fold_pipeline_stats(old)
+        self.pipeline_driver = None
+        if not bool(self.cfg["scheduler"].get("pipeline")):
+            return
+        from volcano_tpu.pipeline import PipelineDriver, pipeline_enabled
+
+        if pipeline_enabled():
+            self.pipeline_driver = PipelineDriver(
+                self.cache, lambda: (self.actions, self.tiers))
+
+    @staticmethod
+    def _fold_stats(total: Dict, stats: Dict) -> Dict:
+        for key, val in stats.items():
+            if isinstance(val, dict):
+                bucket = total.setdefault(key, {})
+                for reason, n in val.items():
+                    bucket[reason] = bucket.get(reason, 0) + n
+            else:
+                total[key] = total.get(key, 0) + val
+        return total
+
+    def _fold_pipeline_stats(self, driver) -> None:
+        if not hasattr(self, "_pipeline_stats_total"):
+            self._pipeline_stats_total = {}
+        self._fold_stats(self._pipeline_stats_total, driver.stats)
+
+    def pipeline_stats_combined(self) -> Dict:
+        """Run-wide pipeline accounting: retired driver generations plus
+        the live one (the auditor's pipeline_no_stale_commit base)."""
+        total: Dict = {}
+        self._fold_stats(total, getattr(self, "_pipeline_stats_total", {}))
+        drv = getattr(self, "pipeline_driver", None)
+        if drv is not None:
+            self._fold_stats(total, drv.stats)
+        return total
 
     def restart_scheduler(self, why: str) -> None:
         """Crash-recover the scheduler: drop the cache (incl. any deferred
@@ -269,8 +321,18 @@ class SimCluster:
         """A second cache following the same store (the warm standby's
         substrate): synchronous watches keep it mirrored; the periodic
         standby slice keeps its SnapshotKeeper/node-axis warm so takeover
-        opens incrementally (scheduler/ha.py WarmStandby, deterministic)."""
-        return self._make_cache()
+        opens incrementally (scheduler/ha.py WarmStandby, deterministic).
+        In pipeline scenarios the buffer pair is armed up front, so the
+        follow slices alternate and warm BOTH buffers — a takeover then
+        pays zero wholesale rebuilds for its first cycle AND its first
+        solve-ahead (the FailoverScheduler does the same)."""
+        cache = self._make_cache()
+        if bool(self.cfg["scheduler"].get("pipeline")):
+            from volcano_tpu.pipeline import pipeline_enabled
+
+            if pipeline_enabled():
+                cache.enable_pipeline()
+        return cache
 
     def _standby_slice(self) -> str:
         cache = self._standby_cache
@@ -296,9 +358,14 @@ class SimCluster:
         given mode — ``mid_defer`` (between a session's actions and its
         close), ``mid_chain`` (after ``after_binds`` more binds inside a
         session — mid-fused-chain for rounds sessions), ``mid_express``
-        (after ``after_binds`` binds inside an express commit)."""
+        (after ``after_binds`` binds inside an express commit),
+        ``mid_spec`` (pipeline scenarios: right after a cycle leaves its
+        speculative solve-ahead dispatched — the deposed term's sealed
+        stage must die through the fence fingerprint, never apply)."""
         if mode == "mid_express" and self.express_lane is None:
             mode = "mid_defer"  # no lane to kill inside; nearest seam
+        if mode == "mid_spec" and self.pipeline_driver is None:
+            mode = "mid_defer"  # no pipeline to kill inside; nearest seam
         self._depose_arm = {"mode": mode, "countdown": int(after_binds),
                             "live": False}
 
@@ -369,6 +436,10 @@ class SimCluster:
             takeover["seq_at_takeover"] = lane.session_seq
             lane.attach(self.cache)
             lane.unpark()
+        # the deposed term's in-flight speculation dies with it (fence
+        # sealed in its fingerprint — it could never apply anyway); the
+        # new term speculates over ITS cache from its first cycle
+        self._rebuild_pipeline_driver()
         self.takeovers.append(takeover)
         self._standby_cache = self._build_standby_cache()
         self._standby_follows = 0
@@ -428,49 +499,10 @@ class SimCluster:
             arm["live"] = True
         win = self._watcher.window() if self._watcher is not None else None
         t0 = time.perf_counter()
-        ssn = open_session(self.cache, self.tiers)
-        t1 = time.perf_counter()
-        try:
-            # fused whole-session dispatch when the session qualifies
-            run_actions(ssn, self.actions)
-        except Exception:
-            if not self._pending_promote:
-                raise
-            # a mid-chain depose aborted a serial effector path: the
-            # fence already protected the store; the deposed session is
-            # abandoned exactly like a crash
-        t2 = time.perf_counter()
-        if arm is not None:
-            arm["live"] = False
-        deposed_mid_defer = False
-        if (arm is not None and arm["mode"] == "mid_defer"
-                and not self._pending_promote):
-            # the kill lands INSIDE the defer window: actions ran (binds
-            # hit the store) but the close never will — and the standby's
-            # lease CAS revokes the dead term's write authority first
-            self._depose_leader("mid_defer")
-            deposed_mid_defer = True
-        if kill:
-            # crash inside the defer window: actions ran (binds hit the
-            # store) but the close-time mirror flush / status writeback
-            # never happens — the scheduler restarts from the store
-            self.session_kills += 1
-            self.restart_scheduler("session-kill")
-            t3 = t2
-        elif deposed_mid_defer:
-            self.session_kills += 1
-            t3 = t2
+        if self.pipeline_driver is not None:
+            t1, t2, t3 = self._pipelined_cycle(t0, kill, arm)
         else:
-            try:
-                close_session(ssn)
-            except Exception:
-                # a deposed-but-alive leader's close: fenced status
-                # writebacks degrade to accounting (status updater), but
-                # any residual path failing must not crash the sim — the
-                # term is over either way
-                if not self._pending_promote:
-                    raise
-            t3 = time.perf_counter()
+            t1, t2, t3 = self._serial_cycle(kill, arm)
         self._open_ms.append((t1 - t0) * 1e3)
         self._actions_ms.append((t2 - t1) * 1e3)
         self._close_ms.append((t3 - t2) * 1e3)
@@ -518,6 +550,94 @@ class SimCluster:
                 f"pending={stats['pending']} running={stats['running']} "
                 f"done={stats['succeeded'] + stats['failed']}"
                 f"{' KILLED' if kill else ''}{audit_note}")
+
+    def _serial_cycle(self, kill, arm):
+        """The serial open -> actions -> close cycle with its chaos seams
+        (the pre-pipeline _session_slice body, verbatim semantics)."""
+        t0 = time.perf_counter()
+        ssn = open_session(self.cache, self.tiers)
+        t1 = time.perf_counter()
+        try:
+            # fused whole-session dispatch when the session qualifies
+            run_actions(ssn, self.actions)
+        except Exception:
+            if not self._pending_promote:
+                raise
+            # a mid-chain depose aborted a serial effector path: the
+            # fence already protected the store; the deposed session is
+            # abandoned exactly like a crash
+        t2 = time.perf_counter()
+        if arm is not None:
+            arm["live"] = False
+        deposed_mid_defer = False
+        if (arm is not None and arm["mode"] == "mid_defer"
+                and not self._pending_promote):
+            # the kill lands INSIDE the defer window: actions ran (binds
+            # hit the store) but the close never will — and the standby's
+            # lease CAS revokes the dead term's write authority first
+            self._depose_leader("mid_defer")
+            deposed_mid_defer = True
+        if kill:
+            # crash inside the defer window: actions ran (binds hit the
+            # store) but the close-time mirror flush / status writeback
+            # never happens — the scheduler restarts from the store
+            self.session_kills += 1
+            self.restart_scheduler("session-kill")
+            t3 = t2
+        elif deposed_mid_defer:
+            self.session_kills += 1
+            t3 = t2
+        else:
+            try:
+                close_session(ssn)
+            except Exception:
+                # a deposed-but-alive leader's close: fenced status
+                # writebacks degrade to accounting (status updater), but
+                # any residual path failing must not crash the sim — the
+                # term is over either way
+                if not self._pending_promote:
+                    raise
+            t3 = time.perf_counter()
+        return t1, t2, t3
+
+    def _pipelined_cycle(self, t0, kill, arm):
+        """One continuous-pipeline cycle (scenario scheduler.pipeline):
+        PipelineDriver.run_cycle commits exactly one session (discarding
+        any invalidated speculation) and leaves the next solve dispatched.
+        Chaos seams: ``mid_chain`` deposes through the bind hook INSIDE
+        the cycle's apply; ``mid_spec`` (and ``mid_defer``, whose defer
+        window is fused into the cycle here) deposes right after the
+        cycle returns — while the next speculative solve is in flight, so
+        the deposed term's sealed stage must die through the fence
+        fingerprint; a session kill crashes the driver between cycles
+        (the speculation dies with the process, binds stay durable)."""
+        info = {}
+        try:
+            info = self.pipeline_driver.run_cycle()
+        except Exception:
+            if not self._pending_promote:
+                raise
+            # a mid-chain depose fenced the cycle's effector path mid-
+            # apply: the store is protected, the term is over, and the
+            # driver already abandoned its speculation
+        t_end = time.perf_counter()
+        if arm is not None:
+            arm["live"] = False
+        if (arm is not None and arm["mode"] in ("mid_spec", "mid_defer")
+                and not self._pending_promote):
+            # the cycle itself completed (commit + close); what dies with
+            # the deposed term is its in-flight SPECULATION — abandoned
+            # at driver rebuild, provably never applied
+            self._depose_leader(arm["mode"])
+        if kill:
+            # crash between cycles: restart from the store's truth
+            self.session_kills += 1
+            self.restart_scheduler("session-kill")
+        # phase split: the driver fuses open/apply into the cycle; the
+        # close wall is reported by the driver, open is not separable
+        close_s = float(info.get("close_ms", 0.0) or 0.0) / 1e3
+        t2 = max(t_end - close_s, t0)
+        return t0, t2, t_end
 
     def _publish_queue_depth(self) -> None:
         depth: Dict[str, int] = {
@@ -668,6 +788,9 @@ class SimCluster:
             "event_log_hash": self.engine.log_hash(),
             "log_records": self.engine.log_records,
             "events_run": self.engine.events_run,
+            "pipeline": (self.pipeline_stats_combined()
+                         if (self.pipeline_driver is not None
+                             or self._pipeline_stats_total) else None),
             "express": ({
                 **{k: v for k, v in
                    self.express_lane.counters.items()},

@@ -39,6 +39,18 @@ _active: Optional[dict] = None
 _inflight: List = []
 
 
+def _forget(x) -> None:
+    """Drop ``x`` from the in-flight list by IDENTITY. list.remove would
+    compare elements with ``==``, which on device arrays broadcasts (and
+    raises outright for mismatched shapes — real the moment two solves
+    of different buckets are in flight, e.g. a speculative solve-ahead
+    behind an uncollected predecessor)."""
+    for i, t in enumerate(_inflight):
+        if t is x:
+            del _inflight[i]
+            return
+
+
 class _Collector(object):
     """Context manager installing a per-session counter dict."""
 
@@ -101,10 +113,7 @@ def start_fetch(x) -> Callable[[], np.ndarray]:
             _active["sync_points"] += 1
             _active["overlap_s"] += t1 - t0
             _active["fence_wait_s"] += time.perf_counter() - t1
-        try:
-            _inflight.remove(x)
-        except ValueError:  # pragma: no cover - double wait
-            pass
+        _forget(x)
         return out
 
     return wait
@@ -114,6 +123,14 @@ def register(x) -> None:
     """Track a dispatched array so a later fence() drains it (for results
     that are consumed device-side rather than fetched)."""
     _inflight.append(x)
+
+
+def discard(x) -> None:
+    """Forget a dispatched array WITHOUT fetching it — the pipeline's
+    invalidated speculative results: the device work is abandoned, the
+    value is never read (the never-applied contract), and later fence()
+    calls no longer wait on it."""
+    _forget(x)
 
 
 def fence(x=None) -> None:
@@ -137,10 +154,7 @@ def fence(x=None) -> None:
         except Exception:  # pragma: no cover - deleted/donated buffers
             pass
         if x is None:
-            try:
-                _inflight.remove(t)
-            except ValueError:  # pragma: no cover
-                pass
+            _forget(t)
     if _active is not None and blocked:
         _active["sync_points"] += 1
         _active["fence_wait_s"] += time.perf_counter() - t0

@@ -289,6 +289,16 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
             }
         out["tpu_residue_ms"] = wp.get("residue_pass_ms", 0.0)
         out["tpu_residue_tasks"] = wp.get("residue_pass_tasks", 0)
+        # encode split (ROADMAP item 3): one opaque encode number hides
+        # whether sharding moved the bottleneck — snapshot is the
+        # session->arrays encode, host_pack the grouped buffer build, h2d
+        # the device staging (per-shard puts under a mesh; h2d_shard_*
+        # counters in tpu_profile carry the per-shard reuse story)
+        out["tpu_encode_split_ms"] = {
+            "snapshot": round(wp.get("encode_s", 0.0) * 1e3, 3),
+            "host_pack": round(wp.get("pack_s", 0.0) * 1e3, 3),
+            "h2d": round(wp.get("h2d_s", 0.0) * 1e3, 3),
+        }
         # steady-state incremental sessions: the production loop reuses ONE
         # cache across cycles, so its open/close ride the delta-maintained
         # snapshot (scheduler/cache/snapkeeper.py) instead of the wholesale
@@ -332,6 +342,109 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
 
 
 _GC_POLICY = None
+
+
+def run_mesh_curve(scale: float, counts, warm_iters: int = 2, cfg: int = 7):
+    """The standing mesh-scaling curve (ROADMAP item 3): cfg7 (paper-2x,
+    100k tasks x 50k nodes at scale 1.0) run at each device count in
+    ``counts``, recording a per-device-count warm-session curve so mesh
+    efficiency is a tracked trajectory number like sessions/sec.
+
+    Two figures per device count:
+    - ``warm_e2e_ms`` / ``solve_ms`` etc: the full warm session under that
+      mesh — on the CPU proxy the virtual devices share one host, so this
+      column is structural (zero warm compiles, sharded staging engaged),
+      not a parallel-speedup claim;
+    - ``per_device_stage_ms``: the MEASURED wall of one shard's slice of
+      the sharded stages (the rounds score refresh + the evict victim
+      fold, ops/shard.probe_per_device_stage_ms) at per-shard width N/d,
+      over the config's real encoded arrays. On the real mesh the shards
+      run concurrently, so this per-shard wall IS the stage's critical
+      path up to the cross-shard verdict reduce — the honest CPU-proxy
+      measurement of the scaling the shard buys
+      (``sharded_stage_speedup_8v1`` is its 8-vs-1 ratio)."""
+    import statistics
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from volcano_tpu.bench.clusters import CONFIGS, make_cache, make_tiers
+    from volcano_tpu.ops import shard as shard_mod
+    from volcano_tpu.ops.solver import _NODE_AXIS
+    from volcano_tpu.scheduler.framework import close_session, open_session
+    from volcano_tpu.scheduler.plugins import tpuscore
+
+    devs = jax.devices()
+    counts = [d for d in counts if d <= len(devs)] or [1]
+    bc = CONFIGS[cfg]
+    # rounds mode forced: the curve's job is the sharded stages, and at
+    # reduced CPU-proxy scales auto mode would hand the session to the
+    # serial loop below its task threshold
+    tiers = make_tiers(["tpuscore"], *bc.tiers,
+                       arguments={"tpuscore": {"tpuscore.mode": "rounds"}})
+
+    def build():
+        cache = make_cache()
+        n_tasks = bc.populate(cache, scale)
+        return cache, n_tasks
+
+    # one encode of the real config feeds the per-shard stage probes
+    cache, n_tasks = build()
+    ssn = open_session(cache, tiers)
+    prep = ssn.batch_allocator._prepare(ssn)
+    probe_arrays = dict(prep["arrays"]) if prep is not None else None
+    probe_spec = prep["spec"] if prep is not None else None
+    close_session(ssn)
+
+    curve = []
+    try:
+        for d in counts:
+            mesh = Mesh(np.array(devs[:d]), ("nodes",)) if d > 1 else None
+            tpuscore.set_default_mesh(mesh)
+            shard_mod.clear_cache()
+            cache, _ = build()
+            cold = _session_once(cache, tiers, bc.actions, mesh=mesh)
+            e2e, w = [], cold
+            for _ in range(max(warm_iters, 1)):
+                cache, _ = build()
+                w = _session_once(cache, tiers, bc.actions, mesh=mesh)
+                e2e.append(w["e2e_s"] * 1e3)
+            p = w["profile"]
+            entry = {
+                "devices": d,
+                "warm_e2e_ms": round(statistics.median(e2e), 3),
+                "solve_ms": round(p.get("solve_s", 0.0) * 1e3, 3),
+                "encode_ms": round(p.get("encode_s", 0.0) * 1e3, 3),
+                "host_pack_ms": round(p.get("pack_s", 0.0) * 1e3, 3),
+                "h2d_ms": round(p.get("h2d_s", 0.0) * 1e3, 3),
+                "h2d_puts": p.get("h2d_puts", 0),
+                "h2d_shard_puts": p.get("h2d_shard_puts", 0),
+                "h2d_shard_cached": p.get("h2d_shard_cached", 0),
+                "warm_compiles": p.get("compiles", 0),
+                "binds": w["binds"],
+            }
+            if probe_arrays is not None:
+                entry["per_device_stage_ms"] = \
+                    shard_mod.probe_per_device_stage_ms(
+                        probe_spec, probe_arrays, _NODE_AXIS, d)
+            curve.append(entry)
+    finally:
+        tpuscore.set_default_mesh(None)
+    out = {"config": cfg, "name": bc.name, "scale": scale,
+           "tasks": n_tasks, "devices": counts, "curve": curve}
+    first, last = curve[0], curve[-1]
+    if "per_device_stage_ms" in first and last["devices"] > 1 \
+            and last.get("per_device_stage_ms"):
+        out["sharded_stage_speedup"] = round(
+            first["per_device_stage_ms"] / last["per_device_stage_ms"], 3)
+        out["sharded_stage_speedup_devices"] = \
+            [first["devices"], last["devices"]]
+    if first.get("warm_e2e_ms") and last.get("warm_e2e_ms") \
+            and last["devices"] > 1:
+        out["warm_e2e_speedup"] = round(
+            first["warm_e2e_ms"] / last["warm_e2e_ms"], 3)
+    return out
 
 
 def run_express(scale: float, arrivals: int = 96, rate_per_s: float = 50.0,
@@ -673,6 +786,30 @@ def _storm_headline(scale: float, seed: int = 7, duration: float = 60.0):
     }
 
 
+def _standing_mesh_curve(scale: float):
+    """The standing cfg7 mesh curve recorded in every all-configs run —
+    in a SUBPROCESS: the CPU proxy needs the 8-virtual-device XLA flag,
+    which must be set before the first jax import and must not reshape
+    the main run's device platform. Returns the parsed tpu_mesh_curve
+    summary object from the child's tail line."""
+    import os
+    import subprocess
+
+    cmd = [sys.executable, os.path.abspath(__file__), "--mesh", "1,2,4,8",
+           "--scale", str(scale), "--warm-iters", "2"]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=1800)
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        curve = obj.get("summary", {}).get("tpu_mesh_curve")
+        if curve is not None:
+            return curve
+    raise RuntimeError(
+        f"mesh-curve subprocess rc={r.returncode}: {r.stderr[-400:]}")
+
+
 _FLOOR_PROBE = None  # (jitted no-op, device operand) or False when absent
 
 
@@ -767,9 +904,11 @@ def main() -> int:
     _GC_POLICY = LowLatencyGC.install()
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=None,
-                    choices=[1, 2, 3, 4, 5, 6],
+                    choices=[1, 2, 3, 4, 5, 6, 7],
                     help="run ONE config (default: all six, headline = cfg 5; "
-                         "cfg6 = cfg2 + affinity/hostPort residue)")
+                         "cfg6 = cfg2 + affinity/hostPort residue; cfg7 = "
+                         "paper-2x 100k tasks x 50k nodes, the mesh-curve "
+                         "standing config)")
     ap.add_argument("--all", action="store_true",
                     help="run all six configs (the default when --config is absent)")
     ap.add_argument("--scale", type=float, default=1.0)
@@ -785,8 +924,19 @@ def main() -> int:
                          "file or committed scenario name "
                          "(volcano_tpu/sim/scenarios) instead of the "
                          "built-in configs")
-    ap.add_argument("--mesh", action="store_true",
-                    help="shard the node axis across all local devices")
+    ap.add_argument("--mesh", nargs="?", const="all", default=None,
+                    help="bare flag: shard the node axis across all local "
+                         "devices for the config runs. With a device-count "
+                         "list (--mesh 1,2,4,8): run the cfg7 mesh-scaling "
+                         "sweep instead, emitting tpu_mesh_curve in the "
+                         "summary tail, then exit")
+    ap.add_argument("--mesh-curve-scale", type=float, default=0.02,
+                    help="cfg7 scale for the STANDING mesh curve recorded "
+                         "in every all-configs run (the explicit "
+                         "--mesh 1,2,4,8 sweep uses --scale)")
+    ap.add_argument("--no-mesh-curve", action="store_true",
+                    help="skip the standing cfg7 mesh curve in the "
+                         "all-configs summary tail")
     ap.add_argument("--express", action="store_true",
                     help="express-lane mode: Poisson interactive arrivals "
                          "against a warm cfg5-scale snapshot; records "
@@ -818,6 +968,37 @@ def main() -> int:
     ap.add_argument("--storm-duration", type=float, default=60.0,
                     help="cfg5_storm simulated horizon, seconds")
     args = ap.parse_args()
+
+    mesh_counts = None
+    if args.mesh is not None and args.mesh != "all":
+        mesh_counts = sorted({max(int(x), 1)
+                              for x in args.mesh.split(",") if x.strip()})
+    # the mesh sweep needs multiple devices; on a CPU-only host force the
+    # virtual device split BEFORE the first jax import (same flag the test
+    # conftest pins) — a no-op when a real multi-device backend exists
+    if mesh_counts is not None and max(mesh_counts) > 1:
+        import os as _os
+
+        flags = _os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            _os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count"
+                        f"={max(mesh_counts)}").strip()
+
+    if mesh_counts is not None:
+        result = run_mesh_curve(args.scale, mesh_counts,
+                                warm_iters=max(args.warm_iters // 2, 1))
+        print(json.dumps({
+            "metric": "cfg7 (paper-2x) per-device sharded-stage wall at "
+                      "%d devices, x %s scale"
+                      % (result["devices"][-1], args.scale),
+            "value": result["curve"][-1].get("per_device_stage_ms", 0.0),
+            "unit": "ms",
+            "vs_baseline": result.get("sharded_stage_speedup", 0.0),
+        }), flush=True)
+        print(json.dumps({"summary": {"tpu_mesh_curve": result}},
+                         separators=(",", ":")), flush=True)
+        return 0
 
     if args.pipeline:
         result = run_pipeline(args.scale, cycles=args.pipeline_cycles,
@@ -1013,6 +1194,16 @@ def main() -> int:
                 args.storm_scale, duration=args.storm_duration)
         except Exception as e:
             print(f"[bench] storm headline failed: {e}", file=sys.stderr)
+    # the standing mesh-scaling curve (ROADMAP item 3): cfg7 at 1/2/4/8
+    # devices in every all-configs run, so mesh efficiency is a tracked
+    # trajectory number like sessions/sec
+    if (not args.no_mesh_curve and args.scenario is None
+            and args.backend in ("tpu", "both", "auto") and len(cfgs) > 1):
+        try:
+            summary["tpu_mesh_curve"] = _standing_mesh_curve(
+                args.mesh_curve_scale)
+        except Exception as e:
+            print(f"[bench] mesh curve failed: {e}", file=sys.stderr)
     print(json.dumps({"summary": summary}, separators=(",", ":")),
           flush=True)
     return 0

@@ -64,3 +64,14 @@ def express_dispatch(batch, jobs, n_nodes):
     spec = ExpressSpec(tb=tb, jb=jb, window_k=window_for(n_nodes, tb))
     req = np.zeros((tb, 2))
     return solve_express(spec, req)
+
+
+def sharded_stage(arrays, spec):
+    # the sharded-staging discipline: pad the node axis to the device
+    # multiple first (append-only, deployment-stable like the mesh pad),
+    # then derive the per-shard width from THAT padded extent — both
+    # helpers are ladder-blessed, so per-shard shapes are mesh-stable
+    nb = pad_axis_multiple(arrays["node_idle"], 0, 8).shape[0]
+    width = per_shard(nb, 8)
+    sl = np.zeros((width, 2))
+    return solve_rounds(spec, {"node_idle": sl})

@@ -52,3 +52,13 @@ def express_dispatch(batch, jobs, dev):
     spec = ExpressSpec(tb=t, jb=len(jobs), window_k=t * 4)  # vclint-expect: VT002
     req = np.zeros((t, 2))  # vclint-expect: VT002
     return solve_express(spec, req)  # vclint-expect: VT002
+
+
+def sharded_stage(arrays, live_nodes, spec):
+    # per-shard slice widths are jit-static shapes (the sharded encoder/
+    # evict staging, ops/shard.py): keyed off raw GLOBAL N they re-key
+    # every shard's program whenever the live node count churns — and at
+    # 8 devices they size per-shard work off the wrong axis entirely
+    width = len(live_nodes) // 8
+    sl = np.zeros((width, 2))  # vclint-expect: VT002
+    return solve_rounds(spec, {"node_idle": sl})

@@ -290,6 +290,35 @@ def test_fence_epoch_discards_speculation():
     _check_accounting(drv.stats)
 
 
+def test_mesh_change_discards_speculation():
+    """A mesh-shape change mid-flight (driver re-installs the default mesh
+    — device added/removed, shard spec change) must discard the sealed
+    stage instead of applying a MIS-SHARDED solve: its packed buffers,
+    window ladder and padded node extent were all keyed to the old device
+    count. Counted as pipeline_spec_discard{reason="mesh"}."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from volcano_tpu.scheduler.plugins import tpuscore
+
+    state = _cluster(17)
+    cache = state["cache"]
+    tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
+    drv = _mk_driver(cache, tiers)
+    try:
+        drv.run_cycle()
+        assert drv._inflight is not None
+        tpuscore.set_default_mesh(
+            Mesh(np.array(jax.devices()[:8]), ("nodes",)))
+        drv.run_cycle()
+        assert drv.stats["spec_discards"].get("mesh", 0) >= 1, drv.stats
+        drv.abandon()
+        _check_accounting(drv.stats)
+    finally:
+        tpuscore.set_default_mesh(None)
+
+
 def test_policy_meta_delta_discards_speculation():
     """A queue spec update (weight change) between seal and apply has no
     per-object dirty mark — QueueInfos re-derive fresh each snapshot —

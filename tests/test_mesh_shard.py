@@ -1,0 +1,262 @@
+"""Mesh-sharded session end-to-end: sharded == unsharded, bit-for-bit.
+
+ROADMAP item 3: the node-axis mesh shard runs through the WHOLE session —
+sharded encoder staging (per-shard device buffers, ops/shard.py), sharded
+evict victim walks (per-shard [N/d, V] folds), and the fused session chain
+with donated carries. The contract these tests pin: under the 8-device
+host mesh (conftest) the sharded session produces bit-identical bindings,
+evictions (in effector order), shares, fit errors and metrics to the
+single-device path — which is itself parity-pinned against the serial
+oracle by tests/test_evict_kernel.py and tests/test_tpu_parity.py, so the
+chain serial == unsharded == sharded closes transitively.
+
+Node counts here are deliberately NOT multiples of 8: the mesh pad
+(append-only slots with sig_mask=False / vic_valid=False / node_real=False)
+and the round-robin window's real-axis wrap (ops/evict._window) are part
+of the contract under test. Runs under ``-m mesh`` (tier-1 at this reduced
+scale; the wide fuzz band is ``-m slow``).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from tests.helpers import close_session, make_cache, make_tiers, open_session
+from tests.test_evict_kernel import (
+    ACTIONS,
+    TIER_SETS,
+    _overcommit_cluster,
+    _session_signature,
+)
+from volcano_tpu.scheduler.framework import get_action
+from volcano_tpu.utils.jaxcompile import CompileWatcher
+
+pytestmark = pytest.mark.mesh
+
+ROUNDS_ARGS = {"tpuscore": {"tpuscore.mode": "rounds"}}
+
+
+def _mesh(devices: int):
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:devices]), ("nodes",))
+
+
+@pytest.fixture(autouse=True)
+def _no_default_mesh_leak():
+    from volcano_tpu.scheduler.plugins import tpuscore
+
+    yield
+    tpuscore.set_default_mesh(None)
+
+
+def _run(cache, tiers_spec, mesh, monkeypatch, fuse: bool,
+         sessions: int = 1, actions=ACTIONS):
+    import volcano_tpu.ops.victimview as vv
+    from volcano_tpu.scheduler.plugins import tpuscore
+
+    monkeypatch.setenv("VOLCANO_TPU_EVICT", "1")
+    monkeypatch.setenv("VOLCANO_TPU_FUSE", "1" if fuse else "0")
+    monkeypatch.setattr(vv.VictimSelector, "MIN_BATCH", 1)
+    tpuscore.set_default_mesh(mesh)
+    try:
+        sig = None
+        profs = []
+        for _ in range(sessions):
+            ssn = open_session(
+                cache, make_tiers(["tpuscore"], *tiers_spec,
+                                  arguments=ROUNDS_ARGS))
+            try:
+                if fuse:
+                    from volcano_tpu.scheduler.framework import run_actions
+
+                    run_actions(ssn, list(actions))
+                else:
+                    for name in actions:
+                        get_action(name).execute(ssn)
+                sig = _session_signature(ssn)
+                profs.append(dict(ssn.plugins["tpuscore"].profile))
+            finally:
+                close_session(ssn)
+    finally:
+        tpuscore.set_default_mesh(None)
+    return sig, dict(cache.binder.binds), list(cache.evictor.evicts), profs
+
+
+@pytest.mark.parametrize("tiers_spec", TIER_SETS)
+@pytest.mark.parametrize("seed", [11, 42])
+def test_sharded_eviction_parity(tiers_spec, seed, monkeypatch):
+    """Satellite contract: per-action preempt/reclaim/backfill under the
+    8-device mesh == unsharded, over op log effects (eviction order),
+    shares, fit errors and preemption metrics — mirroring the rounds-kernel
+    mesh parity tests at the eviction layer."""
+    got = _run(_overcommit_cluster(seed, nodes=5), tiers_spec, _mesh(8),
+               monkeypatch, fuse=False)
+    want = _run(_overcommit_cluster(seed, nodes=5), tiers_spec, None,
+                monkeypatch, fuse=False)
+    assert got[0] == want[0], (tiers_spec, seed)
+    assert got[1] == want[1]          # binds
+    assert got[2] == want[2]          # evictions, in effector order
+    # the sharded kernels must actually have run (no silent fallback)
+    prof = got[3][0]
+    for kind in ("preempt", "reclaim", "backfill"):
+        assert f"evict_{kind}" in prof, prof.get(
+            f"evict_{kind}_fallback", prof)
+    assert prof.get("mesh_devices") == 8, prof
+
+
+@pytest.mark.parametrize("seed", [11, 7])
+def test_sharded_fused_chain_parity(seed, monkeypatch):
+    """The fused chain (allocate -> backfill -> preempt -> reclaim as one
+    device program chain with donated carries) under the mesh == the
+    unsharded fused chain: no stage de-shards the axis mid-session."""
+    tiers_spec = TIER_SETS[0]
+    got = _run(_overcommit_cluster(seed, nodes=6), tiers_spec, _mesh(8),
+               monkeypatch, fuse=True)
+    want = _run(_overcommit_cluster(seed, nodes=6), tiers_spec, None,
+                monkeypatch, fuse=True)
+    assert got[0] == want[0], seed
+    assert got[1] == want[1]
+    assert got[2] == want[2]
+    assert got[3][0].get("fuse") == 1, got[3][0].get(
+        "fuse_fallback", got[3][0])
+
+
+@pytest.mark.parametrize("devices", [2, 4])
+def test_sharded_parity_smaller_meshes(devices, monkeypatch):
+    """The bench sweep's intermediate device counts shard the same axis
+    with different pad extents — parity must hold at each."""
+    tiers_spec = TIER_SETS[0]
+    got = _run(_overcommit_cluster(13, nodes=5), tiers_spec,
+               _mesh(devices), monkeypatch, fuse=False)
+    want = _run(_overcommit_cluster(13, nodes=5), tiers_spec, None,
+                monkeypatch, fuse=False)
+    assert got[0] == want[0], devices
+    assert got[1] == want[1]
+    assert got[2] == want[2]
+
+
+def test_sharded_consecutive_sessions_parity(monkeypatch):
+    """Two back-to-back sharded sessions on one cache: the second rides
+    the SnapshotKeeper delta path and the per-shard device cache — the
+    accounting must stay identical to the unsharded arm."""
+    tiers_spec = TIER_SETS[0]
+    got = _run(_overcommit_cluster(21), tiers_spec, _mesh(8),
+               monkeypatch, fuse=False, sessions=2)
+    want = _run(_overcommit_cluster(21), tiers_spec, None,
+                monkeypatch, fuse=False, sessions=2)
+    assert got[0] == want[0]
+    assert got[1] == want[1]
+    assert got[2] == want[2]
+
+
+def test_sharded_warm_no_compiles(monkeypatch):
+    """Second identical-shape sharded session must reuse every compiled
+    program: the per-shard staging and mesh padding are shape-stable, so
+    a retrace here is a caching regression, not a legitimate compile."""
+    tiers_spec = TIER_SETS[0]
+    _run(_overcommit_cluster(11), tiers_spec, _mesh(8), monkeypatch,
+         fuse=False)
+    watcher = CompileWatcher.install()
+    with watcher.assert_no_compiles("second identical sharded session"):
+        got = _run(_overcommit_cluster(11), tiers_spec, _mesh(8),
+                   monkeypatch, fuse=False)
+    assert "evict_preempt" in got[3][0]
+
+
+def test_sharded_warm_reuses_device_shards(monkeypatch):
+    """Unchanged node rows must not re-cross the link: the second
+    identical session's sharded encode reuses the per-shard device
+    buffers (h2d_shard_cached > 0) instead of re-putting the axis."""
+    tiers_spec = TIER_SETS[0]
+    cache = _overcommit_cluster(11)
+    _run(cache, tiers_spec, _mesh(8), monkeypatch, fuse=False)
+    got = _run(cache, tiers_spec, _mesh(8), monkeypatch, fuse=False)
+    prof = got[3][0]
+    assert prof.get("h2d_shard_cached", 0) > 0, prof
+
+
+class TestShardHelpers:
+    def test_pad_axis_multiple_append_only(self):
+        from volcano_tpu.ops import shard
+
+        a = np.arange(10).reshape(5, 2)
+        p = shard.pad_axis_multiple(a, 0, 8, fill=-1)
+        assert p.shape == (8, 2)
+        assert (p[:5] == a).all() and (p[5:] == -1).all()
+        # already-multiple extents are returned untouched (identity)
+        assert shard.pad_axis_multiple(p, 0, 8) is p
+        assert shard.per_shard(8, 8) == 1
+        assert shard.per_shard(16, 4) == 4
+
+    def test_stage_values_match_single_device_layout(self):
+        """The assembled sharded array's VALUES are the single-device
+        layout byte-for-byte — the oracle contract of the staging."""
+        from volcano_tpu.ops import shard
+
+        mesh = _mesh(8)
+        shard.clear_cache()
+        rng = np.random.default_rng(3)
+        arrays = {"node_idle": rng.uniform(0, 8, (16, 2)),
+                  "sig_mask": rng.random((3, 16)) < 0.5}
+        axes = {"node_idle": 0, "sig_mask": 1}
+        staged = shard.stage_node_arrays(arrays, axes, mesh)
+        for k in arrays:
+            np.testing.assert_array_equal(np.asarray(staged[k]), arrays[k])
+
+    def test_stage_identity_fast_path_skips_puts(self):
+        from volcano_tpu.ops import shard
+
+        mesh = _mesh(8)
+        shard.clear_cache()
+        arr = np.random.default_rng(4).uniform(0, 8, (16, 2))
+        prof1, prof2 = {}, {}
+        shard.stage_node_arrays({"x": arr}, {"x": 0}, mesh, prof1)
+        shard.stage_node_arrays({"x": arr}, {"x": 0}, mesh, prof2)
+        assert prof1["h2d_shard_puts"] == 8
+        assert prof2["h2d_shard_puts"] == 0
+        assert prof2["h2d_shard_cached"] == 8
+
+    def test_stage_dirty_rows_reput_only_their_shard(self):
+        """O(changed rows) per shard: a single changed row re-puts ONE
+        shard; the other seven stay device-resident."""
+        from volcano_tpu.ops import shard
+
+        mesh = _mesh(8)
+        shard.clear_cache()
+        arr = np.random.default_rng(5).uniform(0, 8, (16, 2))
+        shard.stage_node_arrays({"x": arr}, {"x": 0}, mesh, {})
+        arr2 = arr.copy()
+        arr2[3, 0] += 1.0   # row 3 -> shard 1 (width 2)
+        prof = {}
+        staged = shard.stage_node_arrays({"x": arr2}, {"x": 0}, mesh, prof)
+        assert prof["h2d_shard_puts"] == 1, prof
+        assert prof["h2d_shard_cached"] == 7, prof
+        np.testing.assert_array_equal(np.asarray(staged["x"]), arr2)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", list(range(100, 110)))
+def test_sharded_parity_wide(seed, monkeypatch):
+    """Wide fuzz band: the SAME randomized cluster shapes the unsharded
+    wide fuzz proves feasible (test_evict_kernel seeds/rng), re-run
+    sharded-vs-unsharded across tier sets, fused and per-action."""
+    rng = random.Random(seed * 7)
+    kw = dict(nodes=rng.choice([4, 7, 9]),
+              running_jobs=rng.choice([8, 14, 18]),
+              tasks_per_job=rng.choice([3, 4, 5]),
+              queues=rng.choice([2, 3]),
+              hi_jobs=rng.choice([3, 5]))
+    tiers_spec = TIER_SETS[seed % len(TIER_SETS)]
+    fuse = bool(seed % 2)
+    got = _run(_overcommit_cluster(seed, **kw), tiers_spec, _mesh(8),
+               monkeypatch, fuse=fuse)
+    want = _run(_overcommit_cluster(seed, **kw), tiers_spec, None,
+                monkeypatch, fuse=fuse)
+    assert got[0] == want[0], (kw, tiers_spec, fuse)
+    assert got[1] == want[1]
+    assert got[2] == want[2]

@@ -172,6 +172,12 @@ class BucketShape(Rule):
     title = "unbucketed dynamic shape reaches a jit-static sink"
     patterns = ("*/ops/solver.py", "*/ops/rounds.py", "*/ops/evict.py",
                 "*/ops/session_fuse.py",
+                # the sharded encoder/evict staging: per-shard slice
+                # widths and padded extents are jit-static exactly like
+                # pad sizes — and must key off the PER-SHARD node count
+                # (shard.per_shard over the device-multiple-padded
+                # extent), never raw global N
+                "*/ops/shard.py",
                 # the express lane dispatches its own jitted round with
                 # bucket-keyed task/job axes and a top_k candidate window
                 "*/express/*.py")
@@ -187,7 +193,16 @@ class BucketShape(Rule):
                      # disable sentinel), including the mesh-aware
                      # per-shard sizing whose `shards` input is a raw
                      # device count
-                     "_window_fields"}
+                     "_window_fields",
+                     # the sharded-staging size pair (ops/shard.py):
+                     # pad_axis_multiple appends to the device multiple
+                     # (append-only, node-axis contract — the node axis
+                     # is deliberately unbucketed like pad_encoded's
+                     # mesh pad), and per_shard divides THAT padded
+                     # extent by the device count — per-shard shapes
+                     # derived through them are mesh-stable by
+                     # construction
+                     "pad_axis_multiple", "per_shard", "pad_node_axis"}
     PAD_FUNCS = {"_pad_axis"}
     SPEC_CTORS = {"SolveSpec", "EvictSpec", "ExpressSpec"}
     KERNEL_ENTRIES = {"solve_allocate", "solve_rounds", "solve_rounds_packed",

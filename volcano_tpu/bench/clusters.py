@@ -12,6 +12,8 @@ pipeline, not a shortcut.
 | 3   | allocate + drf + proportion: 10 queues, 20k tasks, 5k nodes         |
 | 4   | backfill + preempt, priority/reclaim: 30k tasks, 8k nodes, 30% over |
 | 5   | full default conf at 50k tasks x 10k nodes                          |
+| 6   | cfg2 + required anti-affinity / hostPort pods (serial residue path) |
+| 7   | paper-2x mesh-scaling standing config: 100k tasks x 50k nodes       |
 """
 
 from __future__ import annotations
@@ -280,6 +282,31 @@ def _full_default(c: SchedulerCache, scale: float) -> int:
     return groups * 8
 
 
+def _paper_2x(c: SchedulerCache, scale: float) -> int:
+    """cfg7: the paper-2x standing config — 100k tasks x 50k nodes under
+    the full default conf (ROADMAP item 3). Twice the paper's 50k x 10k
+    north star on BOTH axes the mesh shards over, so the per-device-count
+    scaling curve (bench.py --mesh 1,2,4,8 -> tpu_mesh_curve) is measured
+    against a cluster one chip cannot own: at 8 devices each shard still
+    carries a cfg5-sized node slice."""
+    rng = random.Random(7)
+    tasks, nodes = max(int(100000 * scale), 24), max(int(50000 * scale), 8)
+    groups = tasks // 8
+    for g in range(groups):
+        pg = f"job-{g:05d}"
+        c.add_pod_group(build_pod_group(pg, namespace="bench", min_member=4))
+        for i in range(8):
+            c.add_pod(build_pod(
+                "bench", f"{pg}-t{i}", "", objects.POD_PHASE_PENDING,
+                {"cpu": f"{rng.choice([250, 500, 1000, 2000])}m",
+                 "memory": rng.choice(["512Mi", "1Gi", "2Gi"])}, pg))
+    for n in range(nodes):
+        c.add_node(build_node(
+            f"node-{n:05d}", build_resource_list_with_pods("32", "64Gi", pods=256)))
+    c.add_queue(build_queue("default"))
+    return groups * 8
+
+
 DEFAULT_TIERS = (["priority", "gang"], ["drf", "predicates", "proportion", "nodeorder"])
 
 CONFIGS: Dict[int, BenchConfig] = {
@@ -298,6 +325,8 @@ CONFIGS: Dict[int, BenchConfig] = {
                    "cfg2 + 5% required anti-affinity + hostPort pods (residue path)",
                    _heterogeneous_affinity,
                    (["priority", "gang"], ["predicates", "binpack", "proportion"])),
+    7: BenchConfig("paper-2x", "mesh-scaling standing config: 100k tasks x 50k nodes",
+                   _paper_2x, DEFAULT_TIERS),
 }
 
 

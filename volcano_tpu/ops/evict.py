@@ -132,19 +132,31 @@ def _share2(alloc, total):
     return jnp.maximum(jnp.max(s, axis=-1), 0.0)
 
 
-def _window(elig, rr, num_to_find):
+def _window(elig, rr, num_to_find, real, real_n):
     """The serial round-robin sampling window (predicate_nodes /
     preemptview._window_sel): (selected mask, circular positions from rr,
     processed count). Candidate ORDER within the window is circular-from-rr
-    order — exactly the stable tie order of the serial descending sort."""
+    order — exactly the stable tie order of the serial descending sort.
+
+    ``real``/``real_n`` mask out the mesh pad (ops/shard.py appends node
+    slots to reach the device multiple): padded slots never select, never
+    count as processed, and the circular order wraps over the REAL axis
+    exactly as the serial helper's modulo does — with no padding the
+    arithmetic below is the pre-mesh roll+cumsum bit-for-bit (circ is a
+    permutation and the scatter ranks eligible slots in circular order)."""
     n = elig.shape[0]
-    circ = (jnp.arange(n, dtype=jnp.int32) - rr) % n
-    rolled = jnp.roll(elig, -rr)
-    c = jnp.cumsum(rolled.astype(jnp.int32))
-    found_total = c[-1]
-    sel = jnp.roll(rolled & (c <= num_to_find), rr)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    rn = jnp.maximum(real_n, 1)
+    # padded slots park past every real circular position
+    circ = jnp.where(real, (idx - rr) % rn, jnp.int32(n))
+    er = elig & real
+    cnt = jnp.zeros(n, jnp.int32).at[jnp.minimum(circ, n - 1)].add(
+        jnp.where(real, er, False).astype(jnp.int32))
+    c = jnp.cumsum(cnt)                       # eligible count per circ pos
+    found_total = c[n - 1]
+    sel = er & (c[jnp.minimum(circ, n - 1)] <= num_to_find)
     kth = jnp.argmax(c >= num_to_find).astype(jnp.int32)
-    processed = jnp.where(found_total >= num_to_find, kth + 1, jnp.int32(n))
+    processed = jnp.where(found_total >= num_to_find, kth + 1, rn)
     return sel, circ, processed
 
 
@@ -551,8 +563,9 @@ def _preempt_walk(spec: EvictSpec, enc, st, t, j, intra):
     else:
         elig = mask
     rr0 = st["rr"]
-    sel, circ, processed = _window(elig, rr0, enc["num_to_find"])
-    st = dict(st, rr=(rr0 + processed) % n)
+    sel, circ, processed = _window(elig, rr0, enc["num_to_find"],
+                                   enc["node_real"], enc["real_n"])
+    st = dict(st, rr=(rr0 + processed) % jnp.maximum(enc["real_n"], 1))
     score = kernels.fused_scores(
         spec, enc, st["used"], enc["p_req"][t],
         enc["p_nz_cpu"][t], enc["p_nz_mem"][t], sig)
@@ -1034,6 +1047,67 @@ def solve_backfill(spec: EvictSpec, enc: dict):
 
 _DEVICE_CACHE: Dict[str, tuple] = {}
 
+# node-axis position of every evict-encode array that shards across the
+# mesh (ROADMAP item 3): the tiered victim folds are [N, V] walks —
+# embarrassingly parallel over nodes — so these arrays stage per-shard
+# (ops/shard.py) and the machines' only cross-shard traffic is the small
+# verdict-boundary reduce (victim counts, arg-extrema over nodes)
+_EV_NODE_AXIS = {
+    "node_used": 0, "node_alloc": 0, "node_cnt": 0, "node_max": 0,
+    "node_real": 0,
+    "sig_mask": 1, "affinity_score": 1,
+    "vic_req": 0, "vic_job": 0, "vic_queue": 0, "vic_valid": 0,
+    "vic_alive0": 0, "vic_conf": 0, "vic_cut_perm": 0,
+    "vic_samejob": 0, "vic_samequeue": 0,
+}
+
+# pad fills chosen so mesh-pad slots are invisible to the machines: never
+# eligible (sig_mask), never claimees (vic_valid/alive), never cut
+# (vic_cut_perm), never counted by the round-robin window (node_real)
+_EV_PAD_FILL = {
+    "sig_mask": False, "vic_valid": False, "vic_alive0": False,
+    "vic_conf": False, "node_real": False, "vic_cut_perm": -1,
+    "vic_samejob": False, "vic_samequeue": False,
+}
+
+
+def pad_node_axis(arrays: Dict[str, np.ndarray], multiple: int
+                  ) -> Dict[str, np.ndarray]:
+    """Pad every node-axis array to the mesh device multiple (append-only:
+    real node indices — and hence the op log's node*V+slot codes — are
+    unchanged)."""
+    from volcano_tpu.ops import shard as shard_mod
+
+    out = dict(arrays)
+    for name, axis in _EV_NODE_AXIS.items():
+        if name in out:
+            out[name] = shard_mod.pad_axis_multiple(
+                out[name], axis, multiple, fill=_EV_PAD_FILL.get(name, 0))
+    return out
+
+
+def _pack_staged(arrays: Dict[str, np.ndarray], tag: str, mesh,
+                 profile: Optional[dict] = None):
+    """(layout, staged) for one evict-kernel dispatch: the packed
+    replicated transfer plus — under a mesh — the node-axis arrays padded
+    to the device multiple and staged as per-shard sharded buffers that
+    ride beside the packed groups under their plain names (merged back by
+    rounds.unpack_layout, exactly like the solver's sharded encode)."""
+    if mesh is None:
+        layout, bufs = _pack(arrays, tag)
+        return layout, _stage(bufs, profile)
+    from volcano_tpu.ops import shard as shard_mod
+
+    d = shard_mod.device_count(mesh)
+    padded = pad_node_axis(arrays, d)
+    node = {k: padded[k] for k in _EV_NODE_AXIS if k in padded}
+    rest = {k: v for k, v in padded.items() if k not in node}
+    layout, bufs = _pack(rest, tag)
+    staged = _stage(bufs, profile, mesh=mesh)
+    staged.update(shard_mod.stage_node_arrays(
+        node, _EV_NODE_AXIS, mesh, profile, tag=f"ev.{tag}."))
+    return layout, staged
+
 
 def _pack(arrays: Dict[str, np.ndarray], tag: str):
     """Concatenate host arrays into one flat buffer per dtype class (the
@@ -1063,21 +1137,31 @@ def _pack(arrays: Dict[str, np.ndarray], tag: str):
     return tuple(layout), bufs
 
 
-def _stage(bufs: Dict[str, np.ndarray], profile: Optional[dict] = None):
+def _stage(bufs: Dict[str, np.ndarray], profile: Optional[dict] = None,
+           mesh=None):
     """Host buffers -> device arrays with byte-compared reuse of
-    device-resident twins (same discipline as solver._stage)."""
+    device-resident twins (same discipline as solver._stage, including the
+    mesh-identity guard: a buffer committed for one mesh shape never feeds
+    a program compiled for another)."""
+    from volcano_tpu.ops import shard as shard_mod
+
+    mkey = shard_mod.mesh_key(mesh)
+    sharding = shard_mod.replicated_sharding(mesh) if mesh is not None \
+        else None
     staged = {}
     puts = hits = 0
     for key, buf in bufs.items():
         cached = _DEVICE_CACHE.get(key)
         if (cached is not None and cached[0].dtype == buf.dtype
                 and cached[0].shape == buf.shape
+                and cached[2] == mkey
                 and np.array_equal(cached[0], buf)):
             staged[key] = cached[1]
             hits += 1
         else:
-            dev = jax.device_put(buf)
-            _DEVICE_CACHE[key] = (buf, dev)
+            dev = jax.device_put(buf) if sharding is None \
+                else jax.device_put(buf, sharding)
+            _DEVICE_CACHE[key] = (buf, dev, mkey)
             staged[key] = dev
             puts += 1
     if profile is not None:
@@ -1088,10 +1172,9 @@ def _stage(bufs: Dict[str, np.ndarray], profile: Optional[dict] = None):
 
 @functools.partial(jax.jit, static_argnames=("spec", "layout"))
 def _solve_packed(spec: EvictSpec, layout, bufs):
-    enc = {
-        name: lax.slice_in_dim(bufs[key], off, off + size).reshape(shape)
-        for name, key, off, size, shape in layout
-    }
+    from volcano_tpu.ops import rounds as rounds_mod
+
+    enc = rounds_mod.unpack_layout(layout, bufs)
     if spec.kind == "preempt":
         return solve_preempt.__wrapped__(spec, enc)
     if spec.kind == "reclaim":
@@ -1218,6 +1301,11 @@ class _EvictPlan:
         self.fused = fused
         view = _common_view(ssn, view)
         self.view = view
+        # the session's mesh (tpuscore-installed): the node axis of this
+        # plan's encode shards across it, so the [N, V] victim folds run
+        # as per-shard [N/d, V] folds (ROADMAP item 3)
+        self.mesh = getattr(
+            getattr(ssn, "batch_allocator", None), "mesh", None)
 
         job_order = enc_mod._enabled_plugins(
             ssn, "enabled_job_order", ssn.job_order_fns)
@@ -1435,6 +1523,10 @@ class _EvictPlan:
             vic_req=vic_req, vic_job=vic_job, vic_queue=vic_queue,
             vic_valid=vic_valid, vic_alive0=vic_valid.copy(),
             vic_conf=vic_conf,
+            # real-slot mask + count: the round-robin window must wrap
+            # over the REAL node axis even when the mesh pad appends slots
+            node_real=np.ones(n, bool),
+            real_n=np.int32(n),
             rr0=np.int32(0),
             num_to_find=np.int32(0),
         )
@@ -1520,9 +1612,15 @@ class _EvictPlan:
                 f_elig0=f_elig0, f_vtn0=f_vtn0, f_job_attr=f_job_attr)
             # every fused-stage jit-static size, derived HERE from the
             # bucket ladder (n is deliberately unbucketed, like the node
-            # axis itself — deployment-stable, not churny)
+            # axis itself — deployment-stable, not churny; under a mesh it
+            # is the device-multiple-padded extent so the fused carries
+            # align with the sharded node buffers shard-for-shard)
+            from volcano_tpu.ops import shard as shard_mod
+
+            d = shard_mod.device_count(self.mesh)
             self.fuse_sizes = dict(
-                qp=qp, jcap=jcap, ju=pb, qb=qb, jb=jb, tb=tb, n=n,
+                qp=qp, jcap=jcap, ju=pb, qb=qb, jb=jb, tb=tb,
+                n=((n + d - 1) // d) * d,
                 qh=_bucket(max(len(proc_queues), 1)))
         elif kind == "preempt":
             proc_queues: List[int] = []
@@ -1616,8 +1714,8 @@ class _EvictPlan:
         from volcano_tpu.utils import devprof
 
         t0 = time.perf_counter()
-        layout, bufs = _pack(self.arrays, self.kind)
-        staged = _stage(bufs, prof)
+        layout, staged = _pack_staged(self.arrays, self.kind, self.mesh,
+                                      prof)
         try:
             # async fetch (shared with the session-fused driver): the D2H
             # copy starts at dispatch and overlaps the host-side replay
@@ -1739,6 +1837,8 @@ class _BackfillPlan:
         self.ssn = ssn
         view = _common_view(ssn, view)
         self.view = view
+        self.mesh = getattr(
+            getattr(ssn, "batch_allocator", None), "mesh", None)
         tasks: List = []
         jobs_of: List = []
         sig_ids: Dict[str, int] = {}
@@ -1811,8 +1911,8 @@ class _BackfillPlan:
 
         ssn = self.ssn
         t0 = time.perf_counter()
-        layout, bufs = _pack(self.arrays, "backfill")
-        staged = _stage(bufs, prof)
+        layout, staged = _pack_staged(self.arrays, "backfill", self.mesh,
+                                      prof)
         try:
             wait = devprof.start_fetch(
                 _solve_packed(self.spec, layout, staged))

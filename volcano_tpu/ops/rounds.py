@@ -550,12 +550,23 @@ def _queue_budget(enc, queue_alloc, accept, task_rank, task_queue, task_job):
 
 def unpack_layout(layout, bufs):
     """Static-slice unpack of solver._pack buffers into the enc dict —
-    free under XLA fusion; shared by the packed entry below and the
-    session-fused allocate stage (ops/session_fuse.py)."""
-    return {
+    free under XLA fusion; shared by the packed entry below, the evict
+    packed entry, and the session-fused stages (ops/session_fuse.py).
+
+    Packed-group buffers carry dotted keys ("group.kind"); under a mesh
+    the node-axis arrays ride BESIDE the packed groups as individually
+    sharded buffers under their plain array names (ops/shard.py
+    stage_node_arrays) — merged here, so every packed entrypoint serves
+    both the single-device and the sharded layout without signature
+    changes (the single-device path simply has no plain keys)."""
+    enc = {
         name: lax.slice_in_dim(bufs[key], off, off + size).reshape(shape)
         for name, key, off, size, shape in layout
     }
+    for key in bufs:
+        if "." not in key:
+            enc[key] = bufs[key]
+    return enc
 
 
 def pack_result(enc, raw):

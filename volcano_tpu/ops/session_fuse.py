@@ -32,7 +32,11 @@ stage already knew. This module fuses the remaining per-action boundary:
 Fallback contract (same discipline as ops/evict.py): `VOLCANO_TPU_FUSE=0`
 forces the per-action path byte-for-byte; out-of-envelope sessions
 (residue/releasing/exclusion workloads, scalar resource dims, unsupported
-plugin sets, mesh sharding) never fuse (`fuse_fallback` profile reason);
+plugin sets) never fuse (`fuse_fallback` profile reason). A mesh-sharded
+session fuses like any other: the node axis stays sharded through every
+stage (the evict encodes ship per-shard beside their packed groups,
+ops/evict._pack_staged) and the donated carries ride whole — the win only
+exists if no stage de-shards the axis mid-session (ROADMAP item 3);
 a mid-chain validation failure (allocate residue retry, kernel budget
 exhaustion, panic-mode underflow) applies every stage UP TO the failure
 and runs the remaining actions per-action — nothing from an invalidated
@@ -356,8 +360,7 @@ def try_run(ssn, names) -> Optional[Dict[str, float]]:
     if os.environ.get("VOLCANO_TPU_EVICT", "1") == "0":
         return None
     solver = getattr(ssn, "batch_allocator", None)
-    if solver is None or solver.mesh is not None \
-            or solver.mode not in ("rounds", "auto"):
+    if solver is None or solver.mode not in ("rounds", "auto"):
         return None
     split = _split_chain(tuple(names))
     if split is None:
@@ -488,17 +491,22 @@ def _run_fused(ssn, chain, action_ms, prep, plan, bf, t_chain) -> None:
     prof["fuse"] = 1
     prof["fuse_stages"] = list(chain)
 
+    # under a mesh the evict encodes stage exactly like the sharded
+    # rounds encode: node-axis arrays padded to the device multiple and
+    # shipped per-shard beside the packed groups (the index MAPS stay
+    # replicated — they are gathered by replicated task/assign vectors)
+    mesh = solver.mesh
     maps, bmaps = _build_maps(prep, plan, bf)
     mlayout, mbufs = evict_mod._pack(maps, "fuse_maps")
-    mstaged = evict_mod._stage(mbufs, prof)
-    elayout, ebufs = evict_mod._pack(plan.arrays, "fuse_ev")
-    estaged = evict_mod._stage(ebufs, prof)
+    mstaged = evict_mod._stage(mbufs, prof, mesh=mesh)
+    elayout, estaged = evict_mod._pack_staged(
+        plan.arrays, "fuse_ev", mesh, prof)
     do_backfill = bf is not None and not bf.trivial
     if do_backfill:
-        blayout, bbufs = evict_mod._pack(bf.arrays, "fuse_bf")
-        bstaged = evict_mod._stage(bbufs, prof)
+        blayout, bstaged = evict_mod._pack_staged(
+            bf.arrays, "fuse_bf", mesh, prof)
         bml, bmb = evict_mod._pack(bmaps, "fuse_bmaps")
-        bmstaged = evict_mod._stage(bmb, prof)
+        bmstaged = evict_mod._stage(bmb, prof, mesh=mesh)
 
     # jit-static stage sizes, all off the plan's bucket ladder (VT002)
     fs = plan.fuse_sizes
@@ -532,6 +540,7 @@ def _run_fused(ssn, chain, action_ms, prep, plan, bf, t_chain) -> None:
     # --- stage 1: allocate apply (overlaps the evict stages' compute) -----
     out_a = wait_a()
     prof["pack_s"] = prep["pack_s"]
+    prof["h2d_s"] = prep["h2d_s"]
     prof["dispatch_s"] = time.perf_counter() - t_disp
     assign, meta = solver.parse_packed(out_a)
     solver.apply_packed(ssn, prep, np.asarray(assign), meta)

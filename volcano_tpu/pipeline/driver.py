@@ -166,10 +166,17 @@ class PipelineDriver:
     # -- fingerprint ---------------------------------------------------------
 
     def _fingerprint(self, tiers) -> tuple:
+        from volcano_tpu.scheduler.plugins import tpuscore
+
         lane = getattr(self.cache, "express_lane", None)
         return (self.cache.pipeline_fingerprint(),
                 lane.commit_epoch if lane is not None else -1,
-                id(tiers))
+                id(tiers),
+                # mesh identity (device count + shard spec): a sealed
+                # stage dispatched under one mesh shape is MIS-SHARDED
+                # for any other — its packed buffers, window ladder and
+                # padded node extent all keyed off the old device count
+                tpuscore.mesh_fingerprint())
 
     def _check(self, st: _InFlight, tiers) -> Tuple[bool, str]:
         now = self._fingerprint(tiers)
@@ -178,7 +185,10 @@ class PipelineDriver:
             return True, ""
         # attribute the discard to the first component that moved — the
         # metric label operators alert on
-        (o_cache, o_epoch, o_tiers), (n_cache, n_epoch, n_tiers) = old, now
+        (o_cache, o_epoch, o_tiers, o_mesh) = old
+        (n_cache, n_epoch, n_tiers, n_mesh) = now
+        if o_mesh != n_mesh:
+            return False, "mesh"
         if o_tiers != n_tiers:
             return False, "conf_changed"
         if o_epoch != n_epoch:
@@ -353,6 +363,7 @@ class PipelineDriver:
                     prep["spec"], prep["layout"], prep["staged"]))
                 out = wait()
                 solver.profile["pack_s"] = prep["pack_s"]
+                solver.profile["h2d_s"] = prep["h2d_s"]
                 solver.profile["dispatch_s"] = time.perf_counter() - tp
             else:
                 out = wait()

@@ -47,6 +47,19 @@ def set_default_mesh(mesh) -> None:
     _DEFAULT_MESH = mesh
 
 
+def mesh_fingerprint():
+    """Hashable identity of the driver-installed default mesh (device
+    count + shard spec + device ids), or None without one. Part of the
+    pipeline speculation fingerprint: a speculative solve sealed under one
+    mesh shape is mis-sharded for any other — the stage must discard, not
+    apply (pipeline/driver.py, ``pipeline_spec_discard{reason="mesh"}``)."""
+    m = _DEFAULT_MESH
+    if m is None:
+        return None
+    return (tuple(m.shape.items()),
+            tuple(int(d.id) for d in m.devices.ravel()))
+
+
 class TpuScorePlugin(Plugin):
     def __init__(self, arguments=None):
         self.arguments = arguments or {}

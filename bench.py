@@ -545,6 +545,11 @@ def run_express(scale: float, arrivals: int = 96, rate_per_s: float = 50.0,
         if warm_lat_ms else 0.0,
         "express_placed": lane.counters["placed"],
         "express_deferred": lane.counters["deferred"],
+        # deferral RATE (per arrival) — the number the serving_mix
+        # auditor budget binds on, tracked here as a trajectory column
+        "express_deferral_rate": round(
+            lane.counters["deferred"]
+            / max(lane.counters["arrivals"], 1), 4),
         "express_reconciled": lane.counters["reconciled"],
         "express_reverted": lane.counters["reverted"],
         "express_warm_compiles": compiles,
@@ -776,6 +781,7 @@ def _storm_headline(scale: float, seed: int = 7, duration: float = 60.0):
     cfg = scale_scenario(load_scenario("cfg5_storm"), scale)
     sim = SimCluster(cfg, seed=seed, repro_dir=None)
     s = sim.run(duration=duration)
+    fb = s.get("fallbacks") or {}
     return {
         "sessions_per_sec": s["sessions_per_sec"],
         "p99_task_wait_s": s["task_wait_s"]["p99"],
@@ -783,6 +789,11 @@ def _storm_headline(scale: float, seed: int = 7, duration: float = 60.0):
         "binds": s["binds"],
         "scale": scale,
         "sim_duration_s": s["sim_duration_s"],
+        # envelope honesty as a tracked trajectory number (ROADMAP item
+        # 4): the same rates the sim auditor budgets in chaos_soak /
+        # serving_mix, promoted into the standing tail
+        "fallback_rates": {k: v for k, v in sorted(fb.items())
+                           if k.endswith("_rate")},
     }
 
 
@@ -1024,6 +1035,11 @@ def main() -> int:
                 "pipeline_warm_compiles":
                     result["pipeline"]["warm_compiles"],
                 "spec": result["pipeline"].get("driver", {}),
+                "pipeline_spec_discard_rate": round(
+                    result["pipeline"].get("driver", {}).get(
+                        "spec_discarded", 0)
+                    / max(result["pipeline"].get("driver", {}).get(
+                        "spec_dispatched", 0), 1), 4),
             },
             "pipeline_full": result,
         }}, separators=(",", ":"), default=str), flush=True)

@@ -1,0 +1,51 @@
+"""VT007 negative corpus — covered mutations (mark-before, sync-after,
+callee-closure, caller-coverage), the neutral() bless, and the
+suppression path."""
+
+
+class GoodCache:
+    def __init__(self):
+        self.jobs = {}
+        self.nodes = {}
+        self.snap_keeper = None
+        self._echo = None
+
+    def delete_job(self, uid):
+        # mark-before-mutation on the same path
+        self.snap_keeper.mark_job(uid)
+        self.jobs.pop(uid, None)
+
+    def flush(self, uid, version):
+        # mutate-then-sync: the invalidation may legally FOLLOW the
+        # mutation on the same path (the bulk-flush shape)
+        self.jobs[uid] = object()
+        self.snap_keeper.sync_job(uid, version)
+
+    def delete_via_helper(self, uid):
+        # callee closure: the helper carries the mark
+        self._mark_and_drop(uid)
+
+    def _mark_and_drop(self, uid):
+        self.snap_keeper.mark_evict(uid, "")
+        self.jobs.pop(uid, None)
+
+    def echo(self, job, pg):
+        if pg is self._echo:
+            # vclint: neutral(same-object echo; the value is already visible to every clone)
+            job.set_pod_group(pg)
+            return
+        self.snap_keeper.mark_job("uid")
+        job.set_pod_group(pg)
+
+    def _caller_covered(self, uid):
+        # pure helper: every known caller marks before calling
+        self.jobs.pop(uid, None)
+
+    def covered_caller(self, uid):
+        self.snap_keeper.mark_job(uid)
+        self._caller_covered(uid)
+
+    def suppressed_gap(self, uid):
+        # a REAL finding silenced only by the justified suppression —
+        # proves the disable comment is what silences the rule
+        self.nodes.pop(uid, None)  # vclint: disable=VT007 - corpus fixture: exercises the suppression path

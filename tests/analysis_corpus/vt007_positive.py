@@ -1,0 +1,31 @@
+"""VT007 positive corpus — snapshot-bearing mutations that can complete
+without reaching any invalidation channel (mark / version bump /
+fingerprint component)."""
+
+
+class MiniCache:
+    def __init__(self):
+        self.jobs = {}
+        self.nodes = {}
+        self.snap_keeper = None
+        self._echo = None
+
+    def delete_job_unmarked(self, uid):
+        # no invalidation anywhere in this function's closure, and no
+        # effectful caller exists — the mutation is orphaned
+        self.jobs.pop(uid, None)  # vclint-expect: VT007
+
+    def echo_window(self, job, pg):
+        # the PR 9 shape: the early-return echo path mutates WITHOUT the
+        # mark the normal path performs — it needs an explicit
+        # neutral(<reason>) bless or a mark of its own
+        if pg is self._echo:
+            job.set_pod_group(pg)  # vclint-expect: VT007
+            return
+        self.snap_keeper.mark_job("uid")
+        job.set_pod_group(pg)
+
+    def empty_bless(self, uid):
+        # a neutral() bless with no reason is itself a finding — the
+        # grammar requires the WHY, exactly like VT000 for suppressions
+        self.jobs.pop(uid, None)  # vclint: neutral()  # vclint-expect: VT007

@@ -1,0 +1,41 @@
+"""VT008 negative corpus — consistently guarded fields, transitively
+lock-safe helpers, snapshot-then-dispatch, and the suppression path."""
+
+import threading
+
+
+class GoodLane:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {}
+
+    def noted(self, uid):
+        with self._lock:
+            self.counters[uid] = 1
+
+    def bump(self, uid):
+        with self._lock:
+            self.counters[uid] = 2
+
+    def _helper(self, uid):
+        # every call site is lexically under the lock -> transitively
+        # lock-safe; this write is dynamically guarded
+        self.counters[uid] = 3
+
+    def outer(self, uid):
+        with self._lock:
+            self._helper(uid)
+
+    def snapshot_then_dispatch(self, spec):
+        # the sanctioned shape: snapshot under the lock, dispatch after
+        with self._lock:
+            snap = dict(self.counters)
+        return self._go(snap, spec)
+
+    def _go(self, snap, spec):
+        return solve_rounds_packed(spec)
+
+    def suppressed(self, uid):
+        # a REAL inferred-guard violation silenced only by the justified
+        # suppression
+        self.counters[uid] = 4  # vclint: disable=VT008 - corpus fixture: exercises the suppression path

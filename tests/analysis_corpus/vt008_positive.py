@@ -1,0 +1,33 @@
+"""VT008 positive corpus — inferred lock/field races and device
+dispatch reached through a call made under a held lock."""
+
+import threading
+
+
+class RacyLane:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {}
+        self.pending = []
+
+    def noted(self, uid):
+        # establishes the inferred guard: counters/pending belong to
+        # self._lock
+        with self._lock:
+            self.counters[uid] = 1
+            self.pending.append(uid)
+
+    def racy(self, uid):
+        self.counters[uid] = 2  # vclint-expect: VT008
+
+    def racy_list(self, uid):
+        self.pending.append(uid)  # vclint-expect: VT008
+
+    def dispatch_under_lock(self, spec):
+        with self._lock:
+            return self._go(spec)  # vclint-expect: VT008
+
+    def _go(self, spec):
+        # the device sink is one call away — only the whole-program
+        # closure walk sees it (VT003's lexical check cannot)
+        return solve_rounds_packed(spec)

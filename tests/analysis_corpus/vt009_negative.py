@@ -1,0 +1,23 @@
+"""VT009 negative corpus — every bumped channel sealed, plus the
+suppression path for a derived (transitively-sealed) channel."""
+
+
+class SealedKeeper:
+    def mark_local(self):
+        self.local_epoch += 1
+
+    def wholesale(self):
+        self.local_gen += 1
+
+
+class SealedCacheFingerprint:
+    def pipeline_fingerprint(self):
+        return (self.keeper.local_epoch, self.keeper.local_gen)
+
+
+class DerivedMemo:
+    def refresh(self):
+        # a REAL unsealed-channel finding silenced only by the justified
+        # suppression (the in-tree analog: nodeaxis.epoch, a derived memo
+        # key sealed transitively via dirty_epoch + the acct sum)
+        self.memo_epoch += 1  # vclint: disable=VT009 - corpus fixture: derived memo key, sealed transitively

@@ -1,0 +1,22 @@
+"""VT009 positive corpus — invalidation channels bumped by mutation
+paths but absent from the speculation fingerprint's sealed tuple."""
+
+
+class LeakyKeeper:
+    def mark_foo(self):
+        # a channel the fingerprint below never reads: a speculative
+        # solve sealed before this bump would commit against state it
+        # never saw
+        self.foo_epoch += 1  # vclint-expect: VT009
+
+    def wholesale(self):
+        self.baz_gen += 1  # vclint-expect: VT009
+
+    def mark_bar(self):
+        self.bar_epoch += 1  # sealed below — clean
+
+
+class LeakyCacheFingerprint:
+    def pipeline_fingerprint(self):
+        # seals bar_epoch but neither foo_epoch nor baz_gen
+        return (self.keeper.bar_epoch,)

@@ -249,6 +249,9 @@ def test_express_commit_discards_and_tokens_drain():
         def set_tiers(self, tiers):
             pass
 
+        def _count(self, key, n):
+            self.counters[key] += n
+
     lane = cache.express_lane = _Lane()
     drv.run_cycle()
     assert drv._inflight is not None

@@ -34,7 +34,8 @@ pytestmark = pytest.mark.lint
 
 REPO = Path(__file__).resolve().parent.parent
 CORPUS = Path(__file__).resolve().parent / "analysis_corpus"
-RULE_IDS = ("VT001", "VT002", "VT003", "VT004", "VT005", "VT006")
+RULE_IDS = ("VT001", "VT002", "VT003", "VT004", "VT005", "VT006",
+            "VT007", "VT008", "VT009")
 
 _EXPECT_RE = re.compile(r"#\s*vclint-expect:\s*(VT\d{3})")
 
@@ -140,6 +141,78 @@ class TestFramework:
             cwd=REPO, env=env, capture_output=True, text=True)
         assert neg.returncode == 0, neg.stdout + neg.stderr
         assert json.loads(neg.stdout) == []
+
+
+class TestTooling:
+    """v2 CLI satellites: the JSON report, the suppression baseline, and
+    the --explain effect-chain printer."""
+
+    def _run(self, *argv):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "volcano_tpu.analysis", *argv],
+            cwd=REPO, env=env, capture_output=True, text=True)
+
+    def test_report_file_is_machine_readable(self, tmp_path):
+        report = tmp_path / "report.json"
+        proc = self._run("--report", str(report), "volcano_tpu")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        payload = json.loads(report.read_text())
+        assert set(payload) == {"findings", "suppressed", "counts"}
+        assert payload["findings"] == []
+        # the tree's justified suppressions are IN the report
+        assert any(f["suppressed"] for f in payload["suppressed"])
+
+    def test_baseline_gate_matches_and_drifts(self, tmp_path):
+        base = tmp_path / "base.json"
+        gen = self._run("--write-baseline", str(base), "volcano_tpu")
+        assert gen.returncode == 0, gen.stdout + gen.stderr
+        ok = self._run("--baseline", str(base), "volcano_tpu")
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        # drift: drop a recorded suppression -> the gate must fail with
+        # a 'new suppression' message even though findings are clean
+        payload = json.loads(base.read_text())
+        key = sorted(payload["suppressed"])[0]
+        del payload["suppressed"][key]
+        base.write_text(json.dumps(payload))
+        drift = self._run("--baseline", str(base), "volcano_tpu")
+        assert drift.returncode == 1
+        assert "new suppression" in drift.stderr
+        # the committed baseline matches the committed tree
+        committed = self._run(
+            "--baseline", str(REPO / "tools" / "lint_baseline.json"),
+            "volcano_tpu")
+        assert committed.returncode == 0, committed.stdout + committed.stderr
+
+    def test_explain_prints_effect_chains(self):
+        proc = self._run("--explain", "VT007",
+                         "volcano_tpu/scheduler/cache/cache.py")
+        assert proc.returncode == 0, proc.stderr
+        out = proc.stdout
+        assert "covered via" in out          # callee-closure chains
+        assert "dirty_epoch" in out          # ... naming the channel
+        assert "blessed neutral(" in out     # the echo-window blesses
+        assert "UNCOVERED" not in out        # repo scans clean
+        vt9 = self._run("--explain", "VT009")
+        assert vt9.returncode == 0, vt9.stderr
+        assert "sealed" in vt9.stdout
+        assert "UNSEALED" not in vt9.stdout
+
+    def test_neutral_bless_requires_reason(self):
+        findings = analyze_source(
+            "class C:\n"
+            "    def f(self, uid):\n"
+            "        self.jobs.pop(uid, None)  # vclint: neutral()\n",
+            "inline_neutral.py", respect_filters=False)
+        vt7 = [f for f in findings if f.rule == "VT007"]
+        assert vt7 and "without a reason" in vt7[0].message
+        findings = analyze_source(
+            "class C:\n"
+            "    def f(self, uid):\n"
+            "        self.jobs.pop(uid, None)"
+            "  # vclint: neutral(echo window, see docs)\n",
+            "inline_neutral.py", respect_filters=False)
+        assert not [f for f in findings if f.rule == "VT007"]
 
 
 class TestRepoGate:

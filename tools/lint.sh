@@ -1,14 +1,21 @@
 #!/usr/bin/env bash
 # The lint gate — the ONE definition shared by tests/test_static_analysis.py
 # and any CI wrapper, so "what the gate checks" can never fork:
-#   1. vclint (python -m volcano_tpu.analysis): the VT001-VT005 invariant
-#      rules over the whole package, zero unsuppressed findings required
-#      (rationale per rule: docs/static-analysis.md);
+#   1. vclint (python -m volcano_tpu.analysis): the VT001-VT009 invariant
+#      rules over the whole package — zero unsuppressed findings AND zero
+#      suppression drift against tools/lint_baseline.json (a new justified
+#      suppression must be landed deliberately via --write-baseline);
+#      a machine-readable JSON report lands at $LINT_REPORT
+#      (default /tmp/vclint_report.json) for CI archival;
 #   2. compileall: every module byte-compiles (import-free syntax gate).
 #
-# Usage: tools/lint.sh   (from anywhere; PYTHON overrides the interpreter)
+# Usage: tools/lint.sh   (from anywhere; PYTHON overrides the interpreter,
+#                         LINT_REPORT overrides the report path)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 PY="${PYTHON:-python3}"
-"$PY" -m volcano_tpu.analysis volcano_tpu
+"$PY" -m volcano_tpu.analysis \
+    --baseline tools/lint_baseline.json \
+    --report "${LINT_REPORT:-/tmp/vclint_report.json}" \
+    volcano_tpu
 "$PY" -m compileall -q volcano_tpu

@@ -1,10 +1,14 @@
 """vclint — AST-based invariant checker for this repo's machine-checked
 contracts (kernel purity, bucket shapes, lock discipline, statement
-hygiene, hot-path determinism).
+hygiene, hot-path determinism, and the v2 whole-program effect rules:
+mutation->invalidation reachability, inferred lock/field maps,
+fingerprint completeness — with an opt-in runtime witness shim).
 
 Usage:
     python -m volcano_tpu.analysis volcano_tpu/
     python -m volcano_tpu.analysis --json --select VT003 volcano_tpu/controllers/
+    python -m volcano_tpu.analysis --explain VT007 volcano_tpu/express/
+    python -m volcano_tpu.analysis --baseline tools/lint_baseline.json volcano_tpu/
 
 Rules live in volcano_tpu/analysis/rules.py; the framework (registry,
 suppressions, output) in core.py; rationale per rule in

@@ -1,15 +1,176 @@
 """CLI for vclint: ``python -m volcano_tpu.analysis [paths...]``.
 
-Exit codes: 0 = clean (no unsuppressed findings), 1 = findings, 2 = usage.
+Exit codes: 0 = clean (no unsuppressed findings, baseline matches),
+1 = findings / baseline drift, 2 = usage.
+
+v2 additions:
+- ``--report FILE``: machine-readable JSON report (findings, suppressed
+  findings, per-rule counts) — what CI archives;
+- ``--baseline FILE``: justified suppressions are TRACKED, not just
+  tolerated — the file pins the expected suppressed-finding counts per
+  (rule, file); a new suppression anywhere fails the gate until the
+  baseline is deliberately regenerated with ``--write-baseline``;
+- ``--explain VT007|VT008|VT009``: print the inferred whole-program
+  model — per mutation site the effect chain that covers it (VT007),
+  the inferred lock/field map and locked-region dispatch closures
+  (VT008), the channel-vs-sealed diff (VT009).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 from volcano_tpu.analysis import all_rules, analyze_paths, get_rule, render
+
+
+def _rel(path: str) -> str:
+    """Baseline-stable spelling: strip everything before the package/test
+    root so absolute and relative invocations agree."""
+    norm = path.replace(os.sep, "/")
+    for anchor in ("volcano_tpu/", "tests/"):
+        idx = norm.find(anchor)
+        if idx >= 0:
+            return norm[idx:]
+    return norm
+
+
+def _baseline_counts(findings) -> dict:
+    counts: dict = {}
+    for f in findings:
+        if not f.suppressed:
+            continue
+        key = f"{f.rule} {_rel(f.path)}"
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def _check_baseline(findings, path: str) -> list:
+    """Problems list (empty = baseline matches). Missing file => every
+    suppression is 'new'."""
+    current = _baseline_counts(findings)
+    recorded: dict = {}
+    if os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as fh:
+            recorded = json.load(fh).get("suppressed", {})
+    problems = []
+    for key in sorted(current):
+        if current[key] > recorded.get(key, 0):
+            problems.append(
+                f"new suppression(s) not in baseline: {key} "
+                f"(have {current[key]}, baseline {recorded.get(key, 0)}) "
+                f"— justify it, then regenerate with --write-baseline")
+    for key in sorted(recorded):
+        if recorded[key] > current.get(key, 0):
+            problems.append(
+                f"stale baseline entry: {key} (baseline {recorded[key]}, "
+                f"have {current.get(key, 0)}) — regenerate with "
+                f"--write-baseline")
+    return problems
+
+
+def _write_baseline(findings, path: str) -> None:
+    payload = {
+        "_comment": "vclint suppression baseline — every justified "
+                    "suppression in the tree, pinned per (rule, file). "
+                    "Regenerate via: python -m volcano_tpu.analysis "
+                    "--write-baseline <this file> volcano_tpu",
+        "suppressed": _baseline_counts(findings),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def _write_report(findings, path: str) -> None:
+    active = [f.to_dict() for f in findings if not f.suppressed]
+    muted = [f.to_dict() for f in findings if f.suppressed]
+    by_rule: dict = {}
+    for f in findings:
+        entry = by_rule.setdefault(f.rule, {"active": 0, "suppressed": 0})
+        entry["suppressed" if f.suppressed else "active"] += 1
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"findings": active, "suppressed": muted,
+                   "counts": by_rule}, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def _explain(rule_id: str, paths) -> int:
+    from volcano_tpu.analysis import model as wpm
+
+    model = wpm.package_model()
+    norm = [p.replace(os.sep, "/") for p in paths] if paths else None
+
+    def in_scope(file_path: str) -> bool:
+        rule = get_rule(rule_id)
+        if not rule.applies_to(file_path):
+            return False
+        return norm is None or any(file_path.endswith(n) or n.endswith(
+            file_path) or n in file_path for n in norm)
+
+    if rule_id == "VT007":
+        neutral_cache: dict = {}
+
+        def neutral_for(file_path: str, line: int):
+            if file_path not in neutral_cache:
+                full = os.path.join(
+                    os.path.dirname(wpm._package_root()), file_path)
+                try:
+                    with open(full, "r", encoding="utf-8") as fh:
+                        neutral_cache[file_path] = wpm.neutral_lines(
+                            fh.read())
+                except OSError:
+                    neutral_cache[file_path] = {}
+            blessed = neutral_cache[file_path]
+            return blessed.get(line, blessed.get(line - 1))
+
+        for fi in model.funcs:
+            if not in_scope(fi.path) or not fi.mutations:
+                continue
+            uncovered = {id(s) for s in wpm.uncovered_mutations(model, fi)}
+            for site in fi.mutations:
+                chain = model.effect_chain(fi)
+                if id(site) in uncovered:
+                    reason = neutral_for(site.path, site.line)
+                    verdict = (f"blessed neutral({reason})"
+                               if reason else "UNCOVERED")
+                elif chain is not None:
+                    verdict = "covered via " + " -> ".join(chain)
+                else:
+                    callers = sorted({c.name for c in model.callers.get(
+                        fi.name, []) if c.effectful})
+                    verdict = ("caller-covered via " + ", ".join(callers)
+                               if callers else "covered on-path")
+                print(f"{site.path}:{site.line} {site.desc:42s} "
+                      f"[{fi.name}] {verdict}")
+        return 0
+    if rule_id == "VT008":
+        for key in sorted(model.classes):
+            info = model.classes[key]
+            if not in_scope(key.split("::", 1)[0]):
+                continue
+            print(f"{key}: locks={sorted(info.locks)} "
+                  f"lock_safe={sorted(info.lock_safe)}")
+            for field in sorted(info.locked_writes):
+                print(f"  {field}: locked_in="
+                      f"{sorted(info.locked_writes[field])} "
+                      f"unlocked_in="
+                      f"{sorted({m for m, _, _ in info.unlocked_writes.get(field, [])})}")
+        return 0
+    if rule_id == "VT009":
+        rule = get_rule("VT009")
+        sealed = rule._sealed_attrs(model, None, "")
+        print(f"sealed attrs: {sorted(a for a in sealed if rule._CHANNEL_ATTR.search(a))}")
+        for ch in sorted(model.channel_sites):
+            for path, line, attr in model.channel_sites[ch]:
+                state = "sealed" if attr in sealed else "UNSEALED"
+                print(f"{path}:{line} {attr:20s} channel={ch:15s} {state}")
+        return 0
+    print(f"--explain supports VT007/VT008/VT009, not {rule_id}",
+          file=sys.stderr)
+    return 2
 
 
 def main(argv=None) -> int:
@@ -31,6 +192,18 @@ def main(argv=None) -> int:
                              "per-rule path scopes (corpus/test mode)")
     parser.add_argument("--list-rules", action="store_true",
                         help="list registered rules and exit")
+    parser.add_argument("--report", default=None, metavar="FILE",
+                        help="write a machine-readable JSON report "
+                             "(findings + suppressed + per-rule counts)")
+    parser.add_argument("--baseline", default=None, metavar="FILE",
+                        help="compare justified suppressions against this "
+                             "baseline; any drift fails the gate")
+    parser.add_argument("--write-baseline", default=None, metavar="FILE",
+                        help="regenerate the suppression baseline from "
+                             "the current tree and exit")
+    parser.add_argument("--explain", default=None, metavar="VT007",
+                        help="print the inferred whole-program model for "
+                             "VT007/VT008/VT009 and exit")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -38,6 +211,9 @@ def main(argv=None) -> int:
             scopes = ", ".join(rule.patterns) or "(meta)"
             print(f"{rule.id}  {rule.title}  [{scopes}]")
         return 0
+
+    if args.explain:
+        return _explain(args.explain.strip(), args.paths)
 
     rules = None
     if args.select:
@@ -53,9 +229,26 @@ def main(argv=None) -> int:
 
     findings = analyze_paths(paths, rules,
                              respect_filters=not args.no_default_filter)
+
+    if args.write_baseline:
+        _write_baseline(findings, args.write_baseline)
+        print(f"baseline written: {args.write_baseline} "
+              f"({sum(_baseline_counts(findings).values())} suppression(s))")
+        return 0
+    if args.report:
+        _write_report(findings, args.report)
+
+    baseline_problems = []
+    if args.baseline:
+        baseline_problems = _check_baseline(findings, args.baseline)
+
     print(render(findings, as_json=args.as_json,
                  show_suppressed=args.show_suppressed))
-    return 1 if any(not f.suppressed for f in findings) else 0
+    for problem in baseline_problems:
+        print(f"vclint baseline: {problem}", file=sys.stderr)
+    if any(not f.suppressed for f in findings) or baseline_problems:
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

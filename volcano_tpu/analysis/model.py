@@ -1,0 +1,767 @@
+"""Whole-program effect model for vclint v2 (VT007-VT009).
+
+PR 1's rules are per-file pattern checks; the mutation->invalidation
+contract (every cache/session-state mutation must mark a SnapshotKeeper
+dirty-set, bump an accounting generation, or feed a pipeline-speculation
+fingerprint component) is a WHOLE-PROGRAM property: the mark frequently
+lives in a callee (``cache.bind`` marks before the binder dispatches) or
+in every caller (``_process_cleanup_jobs`` runs only under
+``_delete_job``'s mark). This module builds the shared program model those
+rules consume:
+
+- every function/method in the package, indexed by short name with a
+  conservative name-based call graph (a short name that resolves to more
+  than ``RESOLVE_CAP`` definitions is treated as unresolvable rather than
+  letting mega-generic names like ``execute`` cover everything);
+- **effect channels**: the invalidation sinks (``mark_*`` /
+  ``invalidate`` / ``sync_*`` on the keeper, ``_acct_gen`` /
+  ``_status_version`` / ``dirty_epoch`` / ``generation`` /
+  ``commit_epoch`` bumps, and the native flush twins
+  ``mirror_all_jobs`` / ``apply_node_deltas`` which bump generations in
+  C) plus the transitive ``effectful(fn)`` closure over the call graph;
+- **mutation sites**: assignments / mutating calls on snapshot-bearing
+  state — NodeInfo/JobInfo task maps and resource sums, pod-table rows,
+  the cache's jobs/nodes/queues/priority-class/namespace containers,
+  ``.status`` / ``.status.phase`` / ``.node_name`` writes, and node-axis
+  row refreshes;
+- **path sensitivity** (per function): a mutation is covered only if
+  every path through it also passes an effectful statement — which is
+  exactly what makes the PR 9 echo windows (mutate-and-return before the
+  mark) visible and in need of an explicit ``# vclint: neutral(<reason>)``
+  bless;
+- **lock inference** (VT008): per class, which ``self.<field>`` sets are
+  written under which ``with <lock>:`` blocks, the transitive
+  "lock-safe" method set (every call site lexically under the lock), and
+  the callee closure of each locked region for the
+  device-dispatch-under-lock check.
+
+The package model is built once per process (``package_model()``) from
+the installed ``volcano_tpu`` tree; per-file checks overlay the file
+being analyzed so corpus fixtures and in-memory sources resolve
+file-locally first.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# -- knobs ------------------------------------------------------------------
+
+# a short name defined more than this many times across the program is
+# treated as unresolvable: generic names (execute, run, check) must not
+# accidentally cover a mutation path or hide a dispatch
+RESOLVE_CAP = 5
+
+# invalidation sinks by call name: keeper marks, bulk-flush syncs, and
+# the native flush twins (they bump _acct_gen/_status_version in C)
+EFFECT_CALLS = {
+    "mark_job": "dirty_epoch",
+    "mark_node": "dirty_epoch",
+    "mark_evict": "dirty_epoch",
+    "mark_meta": "dirty_epoch",
+    "invalidate": "generation",
+    "sync_job": "keeper_sync",
+    "sync_node": "keeper_sync",
+    "mirror_all_jobs": "acct_gen",
+    "apply_node_deltas": "acct_gen",
+}
+
+# invalidation channels by bumped attribute (AugAssign += on the attr)
+EFFECT_ATTR_BUMPS = {
+    "_acct_gen": "acct_gen",
+    "_status_version": "status_version",
+    "dirty_epoch": "dirty_epoch",
+    "generation": "generation",
+    "commit_epoch": "commit_epoch",
+}
+
+# snapshot-bearing mutating method calls (receiver-attr name)
+MUTATING_CALLS = {
+    "add_task", "remove_task", "update_task", "set_node",
+    "add_task_info", "delete_task_info", "update_task_status",
+    "set_pod_group", "unset_pod_group", "set_pdb", "unset_pdb",
+    "mirror_bind", "mirror_evict", "refresh_rows", "_add_res_vec",
+}
+
+# snapshot-bearing containers: subscript writes / mutating dict calls on
+# an attribute chain ending in one of these
+STATE_CONTAINERS = {
+    "jobs", "nodes", "queues", "priority_classes",
+    "namespace_collection", "tasks",
+}
+_CONTAINER_MUTATORS = {"pop", "setdefault", "clear", "update"}
+
+# receivers whose wholesale REBIND is a mutation (self.jobs = {} on a
+# cache); session objects (ssn.jobs = {}) are per-cycle clones
+_REBIND_RECEIVERS = re.compile(r"^(self|cls)$|cache$")
+
+# resource-sum receivers: .add()/.sub() on these attr chains mutate
+# snapshot accounting
+RESOURCE_SUMS = {"idle", "used", "allocated", "pending_sum"}
+
+_LOCK_NAME = re.compile(r"(^|_)(lock|mu|mutex|cond|qlock)$")
+
+# device-dispatch / D2H sinks for the VT008 closure check (superset of
+# VT003's lexical set)
+DEVICE_DISPATCH = {
+    "solve_rounds_packed", "solve_rounds", "solve_allocate",
+    "solve_express", "solve_preempt", "solve_reclaim", "solve_backfill",
+    "solve_fused_chain", "start_fetch", "device_put", "block_until_ready",
+}
+
+_NEUTRAL_RE = re.compile(r"vclint:\s*neutral\(([^)]*)\)")
+
+
+def dotted_chain(node: ast.AST) -> List[str]:
+    """['a','b','c'] for a.b.c; [] when the chain bottoms out in a call
+    or subscript."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+class MutationSite:
+    __slots__ = ("path", "line", "col", "desc", "func")
+
+    def __init__(self, path, line, col, desc, func):
+        self.path = path
+        self.line = line
+        self.col = col
+        self.desc = desc
+        self.func = func  # FuncInfo
+
+
+class FuncInfo:
+    __slots__ = ("name", "qualname", "cls", "path", "node", "callees",
+                 "effects", "mutations", "effectful", "lock_blocks")
+
+    def __init__(self, name, qualname, cls, path, node):
+        self.name = name
+        self.qualname = qualname
+        self.cls = cls            # class name or None
+        self.path = path
+        self.node = node
+        self.callees: Set[str] = set()       # short names called
+        self.effects: Set[str] = set()       # direct channels
+        self.mutations: List[MutationSite] = []
+        self.effectful = False               # closure result
+        # [(with-node, lock-desc, [call short names lexically inside])]
+        self.lock_blocks: List[Tuple[ast.With, str, List[ast.Call]]] = []
+
+
+class ClassLockInfo:
+    """Per-class lock/field inference (VT008)."""
+
+    __slots__ = ("name", "path", "locks", "locked_writes",
+                 "unlocked_writes", "lock_safe")
+
+    def __init__(self, name, path):
+        self.name = name
+        self.path = path
+        self.locks: Set[str] = set()
+        # field -> set of method names that write it under a lock
+        self.locked_writes: Dict[str, Set[str]] = {}
+        # field -> [(method, line, col)] writes outside any lock
+        self.unlocked_writes: Dict[str, List[Tuple[str, int, int]]] = {}
+        self.lock_safe: Set[str] = set()
+
+
+def neutral_lines(src: str) -> Dict[int, str]:
+    """line -> reason for every ``# vclint: neutral(<reason>)`` comment
+    (comments only, via the tokenizer — a 'neutral(' in a string can
+    never bless a mutation)."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _NEUTRAL_RE.search(tok.string)
+            if m is not None:
+                out[tok.start[0]] = m.group(1).strip()
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+class ProgramModel:
+    def __init__(self):
+        self.funcs: List[FuncInfo] = []
+        self.by_short: Dict[str, List[FuncInfo]] = {}
+        self.by_qual: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassLockInfo] = {}   # "path::Class"
+        self.callers: Dict[str, List[FuncInfo]] = {}  # short -> callers
+        self.files: Dict[str, ast.AST] = {}
+        # channel -> [(path, line, attr)] bump sites (VT009)
+        self.channel_sites: Dict[str, List[Tuple[str, int, str]]] = {}
+
+    # -- construction ------------------------------------------------------
+
+    def add_file(self, path: str, tree: ast.AST) -> None:
+        if path in self.files:
+            return
+        self.files[path] = tree
+        owner: Dict[int, str] = {}
+        for cls in [n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef)]:
+            for item in cls.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    owner[id(item)] = cls.name
+        for fn in [n for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            cls = owner.get(id(fn))
+            qual = f"{path}::{cls + '.' if cls else ''}{fn.name}"
+            fi = FuncInfo(fn.name, qual, cls, path, fn)
+            self._scan_func(fi)
+            self.funcs.append(fi)
+            self.by_short.setdefault(fn.name, []).append(fi)
+            self.by_qual[qual] = fi
+
+    def _scan_func(self, fi: FuncInfo) -> None:
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                name = None
+                if isinstance(node.func, ast.Attribute):
+                    name = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    name = node.func.id
+                if name:
+                    fi.callees.add(name)
+                    ch = EFFECT_CALLS.get(name)
+                    if ch:
+                        fi.effects.add(ch)
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Attribute):
+                ch = EFFECT_ATTR_BUMPS.get(node.target.attr)
+                if ch:
+                    fi.effects.add(ch)
+                    self.channel_sites.setdefault(ch, []).append(
+                        (fi.path, node.lineno, node.target.attr))
+            if isinstance(node, ast.With):
+                desc = self._lock_desc(node)
+                if desc:
+                    calls = [c for c in self._walk_no_defs(node.body)
+                             if isinstance(c, ast.Call)]
+                    fi.lock_blocks.append((node, desc, calls))
+        fi.mutations = list(self._mutation_sites(fi))
+
+    @staticmethod
+    def _lock_desc(node: ast.With) -> Optional[str]:
+        for item in node.items:
+            chain = dotted_chain(item.context_expr)
+            if chain and _LOCK_NAME.search(chain[-1]):
+                return ".".join(chain)
+        return None
+
+    @staticmethod
+    def _walk_no_defs(body):
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- mutation-site detection ------------------------------------------
+
+    def _mutation_sites(self, fi: FuncInfo):
+        if fi.name in ("__init__", "__new__"):
+            return  # constructing fresh state mutates nothing shared
+        for node in self._walk_no_defs(fi.node.body):
+            if isinstance(node, ast.Call):
+                site = self._call_mutation(node, fi)
+                if site:
+                    yield site
+            elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    site = self._target_mutation(tgt, node, fi)
+                    if site:
+                        yield site
+                        break
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        chain = dotted_chain(tgt.value)
+                        if chain and chain[-1] in STATE_CONTAINERS:
+                            yield MutationSite(
+                                fi.path, node.lineno, node.col_offset,
+                                f"del {'.'.join(chain)}[...]", fi)
+                            break
+
+    def _call_mutation(self, node: ast.Call, fi: FuncInfo):
+        func = node.func
+        if isinstance(func, ast.Name) and func.id in MUTATING_CALLS:
+            return MutationSite(fi.path, node.lineno, node.col_offset,
+                                f"{func.id}(...)", fi)
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        chain = dotted_chain(func.value)
+        if attr in MUTATING_CALLS:
+            recv = ".".join(chain) if chain else "<expr>"
+            return MutationSite(fi.path, node.lineno, node.col_offset,
+                                f"{recv}.{attr}(...)", fi)
+        if attr in ("add", "remove") and chain \
+                and chain[-1] == "pod_table":
+            return MutationSite(fi.path, node.lineno, node.col_offset,
+                                f"pod_table.{attr}(...)", fi)
+        if attr in ("add", "sub") and chain and chain[-1] in RESOURCE_SUMS:
+            return MutationSite(fi.path, node.lineno, node.col_offset,
+                                f"{'.'.join(chain)}.{attr}(...)", fi)
+        if attr in _CONTAINER_MUTATORS and chain \
+                and chain[-1] in STATE_CONTAINERS:
+            return MutationSite(fi.path, node.lineno, node.col_offset,
+                                f"{'.'.join(chain)}.{attr}(...)", fi)
+        return None
+
+    def _target_mutation(self, tgt, stmt, fi: FuncInfo):
+        if isinstance(tgt, ast.Subscript):
+            chain = dotted_chain(tgt.value)
+            if chain and chain[-1] in STATE_CONTAINERS:
+                return MutationSite(
+                    fi.path, stmt.lineno, stmt.col_offset,
+                    f"{'.'.join(chain)}[...] = ...", fi)
+            return None
+        if not isinstance(tgt, ast.Attribute):
+            return None
+        chain = dotted_chain(tgt)
+        if not chain:
+            return None
+        attr = chain[-1]
+        if attr in STATE_CONTAINERS and len(chain) >= 2 \
+                and _REBIND_RECEIVERS.search(chain[-2]):
+            return MutationSite(fi.path, stmt.lineno, stmt.col_offset,
+                                f"{'.'.join(chain)} = ... (rebind)", fi)
+        if attr == "status" and "spec" not in chain:
+            return MutationSite(fi.path, stmt.lineno, stmt.col_offset,
+                                f"{'.'.join(chain)} = ...", fi)
+        if attr == "phase" and "status" in chain:
+            return MutationSite(fi.path, stmt.lineno, stmt.col_offset,
+                                f"{'.'.join(chain)} = ...", fi)
+        if attr == "node_name" and "spec" not in chain:
+            return MutationSite(fi.path, stmt.lineno, stmt.col_offset,
+                                f"{'.'.join(chain)} = ...", fi)
+        if attr == "conditions" or "conditions" in chain:
+            return MutationSite(fi.path, stmt.lineno, stmt.col_offset,
+                                f"{'.'.join(chain)} = ...", fi)
+        return None
+
+    # -- resolution + effect closure --------------------------------------
+
+    def finalize(self) -> None:
+        """Compute the transitive effectful() set and the reverse call
+        graph. Idempotent; call after the last add_file."""
+        self.callers = {}
+        for fi in self.funcs:
+            for callee in fi.callees:
+                self.callers.setdefault(callee, []).append(fi)
+        for fi in self.funcs:
+            fi.effectful = bool(fi.effects)
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.funcs:
+                if fi.effectful:
+                    continue
+                for callee in fi.callees:
+                    for target in self.resolve(callee, fi):
+                        if target.effectful:
+                            fi.effectful = True
+                            changed = True
+                            break
+                    if fi.effectful:
+                        break
+        for cls_key, info in self.classes.items():
+            self._lock_safe_fixpoint(cls_key, info)
+
+    def resolve(self, short: str, from_fn: Optional[FuncInfo] = None
+                ) -> List[FuncInfo]:
+        """Candidates for a short call name: same-class methods first,
+        then same-file, then program-wide — unresolvable past
+        RESOLVE_CAP."""
+        cands = self.by_short.get(short, [])
+        if not cands:
+            return []
+        if from_fn is not None:
+            same_cls = [c for c in cands if c.cls and c.cls == from_fn.cls
+                        and c.path == from_fn.path]
+            if same_cls:
+                return same_cls
+            same_file = [c for c in cands if c.path == from_fn.path]
+            if same_file and len(same_file) <= RESOLVE_CAP:
+                return same_file
+        if len(cands) > RESOLVE_CAP:
+            return []
+        return cands
+
+    def effect_chain(self, fi: FuncInfo, limit: int = 6
+                     ) -> Optional[List[str]]:
+        """BFS from fi to a direct effect; ['f', 'g', 'mark_job'] style,
+        or None when the closure is effect-free."""
+        if fi.effects:
+            return [fi.name, sorted(fi.effects)[0]]
+        seen = {fi.qualname}
+        frontier: List[Tuple[FuncInfo, List[str]]] = [(fi, [fi.name])]
+        for _ in range(limit):
+            nxt: List[Tuple[FuncInfo, List[str]]] = []
+            for fn, chain in frontier:
+                for callee in sorted(fn.callees):
+                    for target in self.resolve(callee, fn):
+                        if target.qualname in seen:
+                            continue
+                        seen.add(target.qualname)
+                        if target.effects:
+                            return chain + [target.name,
+                                            sorted(target.effects)[0]]
+                        nxt.append((target, chain + [target.name]))
+            frontier = nxt
+            if not frontier:
+                break
+        return None
+
+    # -- lock inference (VT008) -------------------------------------------
+
+    def scan_class_locks(self, path: str, tree: ast.AST) -> None:
+        for cls in [n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef)]:
+            info = ClassLockInfo(cls.name, path)
+            methods = [m for m in cls.body
+                       if isinstance(m, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            for m in methods:
+                locked_nodes: Set[int] = set()
+                for node in ast.walk(m):
+                    if isinstance(node, ast.With):
+                        lock = None
+                        for item in node.items:
+                            chain = dotted_chain(item.context_expr)
+                            if chain and chain[0] in ("self", "cls") \
+                                    and _LOCK_NAME.search(chain[-1]):
+                                lock = chain[-1]
+                        if lock is None:
+                            continue
+                        info.locks.add(lock)
+                        for sub in self._walk_no_defs(node.body):
+                            locked_nodes.add(id(sub))
+                for node, field in self._field_write_nodes(m):
+                    if m.name == "__init__":
+                        continue
+                    if id(node) in locked_nodes:
+                        info.locked_writes.setdefault(
+                            field, set()).add(m.name)
+                    else:
+                        info.unlocked_writes.setdefault(field, []).append(
+                            (m.name, node.lineno, node.col_offset))
+            if info.locks:
+                self.classes[f"{path}::{cls.name}"] = info
+
+    @staticmethod
+    def _field_write_nodes(method):
+        """(node, field) for every self.<field> write in the method:
+        attribute/subscript assignment, aug-assign, and mutating
+        container-method calls."""
+        for node in ProgramModel._walk_no_defs(method.body):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    base = tgt
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    chain = dotted_chain(base)
+                    if len(chain) >= 2 and chain[0] in ("self", "cls"):
+                        yield node, chain[1]
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("append", "add", "pop",
+                                           "clear", "update", "extend",
+                                           "remove", "discard",
+                                           "setdefault", "popleft",
+                                           "appendleft"):
+                chain = dotted_chain(node.func.value)
+                if len(chain) >= 2 and chain[0] in ("self", "cls"):
+                    yield node, chain[1]
+
+    def _lock_safe_fixpoint(self, cls_key: str, info: ClassLockInfo) -> None:
+        """Methods whose every in-class call site sits lexically under one
+        of the class's locks (transitively) — their 'unlocked' writes are
+        dynamically guarded and must not be flagged."""
+        path, cls_name = cls_key.split("::", 1)
+        methods = {fi.name: fi for fi in self.funcs
+                   if fi.path == path and fi.cls == cls_name}
+        # call sites: method -> [(caller, lexically-under-lock?)]
+        sites: Dict[str, List[Tuple[str, bool]]] = {}
+        for name, fi in methods.items():
+            locked_ids: Set[int] = set()
+            for node, desc, _calls in fi.lock_blocks:
+                for sub in self._walk_no_defs(node.body):
+                    locked_ids.add(id(sub))
+            for node in self._walk_no_defs(fi.node.body):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self" \
+                        and node.func.attr in methods:
+                    sites.setdefault(node.func.attr, []).append(
+                        (name, id(node) in locked_ids))
+        safe: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name in methods:
+                if name in safe:
+                    continue
+                calls = sites.get(name)
+                if not calls:
+                    continue
+                if all(locked or caller in safe
+                       for caller, locked in calls):
+                    safe.add(name)
+                    changed = True
+        info.lock_safe = safe
+
+
+# -- package model singleton -------------------------------------------------
+
+_PACKAGE_MODEL: Optional[ProgramModel] = None
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def package_model() -> ProgramModel:
+    """The whole-package model, built once per process from the installed
+    volcano_tpu tree (syntax-broken files are skipped — VT999 reports
+    them through the normal per-file path)."""
+    global _PACKAGE_MODEL
+    if _PACKAGE_MODEL is not None:
+        return _PACKAGE_MODEL
+    model = ProgramModel()
+    root = _package_root()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, name)
+            try:
+                with open(full, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+                tree = ast.parse(src)
+            except (OSError, SyntaxError):
+                continue
+            rel = os.path.relpath(full, os.path.dirname(root))
+            model.add_file(rel, tree)
+            model.scan_class_locks(rel, tree)
+    model.finalize()
+    _PACKAGE_MODEL = model
+    return model
+
+
+def overlay_model(path: str, tree: ast.AST) -> ProgramModel:
+    """Package model + the file under analysis. When ``path`` is already
+    part of the package tree (repo-gate runs), the cached model is
+    returned as-is; out-of-tree files (corpus fixtures, inline sources)
+    get a fresh merged model so their definitions resolve file-locally."""
+    base = package_model()
+    norm = path.replace(os.sep, "/")
+    for known in base.files:
+        if norm.endswith(known.replace(os.sep, "/")):
+            return base
+    merged = ProgramModel()
+    merged.add_file(path, tree)
+    merged.scan_class_locks(path, tree)
+    for p, t in base.files.items():
+        merged.add_file(p, t)
+    for key, info in base.classes.items():
+        merged.classes.setdefault(key, info)
+    merged.finalize()
+    return merged
+
+
+def reset_package_model() -> None:
+    global _PACKAGE_MODEL
+    _PACKAGE_MODEL = None
+
+
+# -- path-sensitive coverage walk -------------------------------------------
+
+
+class PathWalk:
+    """Forward structural walk of one function body answering: which
+    mutation sites lie on at least one entry->exit path that contains no
+    effectful statement? ('effectful' = contains an invalidation sink or
+    a call whose closure is effectful.) Loops are optimistic (a body
+    effect covers sites pending at loop exit — the iteration-2 argument);
+    ``raise`` terminates a path without flagging (effector error paths
+    resync, they do not owe a mark)."""
+
+    def __init__(self, model: ProgramModel, fi: FuncInfo):
+        self.model = model
+        self.fi = fi
+        self.sites_by_stmt: Dict[int, List[MutationSite]] = {}
+        for site in fi.mutations:
+            self.sites_by_stmt.setdefault(site.line, []).append(site)
+        self.flagged: List[MutationSite] = []
+        self._flagged_ids: Set[int] = set()
+
+    def run(self) -> List[MutationSite]:
+        clean, pending = self._walk(self.fi.node.body, True, [])
+        if clean:
+            self._flag_all(pending)
+        return self.flagged
+
+    # returns (clean_fallthrough, pending_sites)
+    def _walk(self, stmts, clean: bool, pending: List[MutationSite]):
+        pending = list(pending)
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Return):
+                if clean:
+                    self._flag_all(pending)
+                return False, []
+            if isinstance(stmt, ast.Raise):
+                return False, []
+            if isinstance(stmt, ast.If):
+                c1, p1 = self._walk(stmt.body, clean,
+                                    pending + self._own(stmt, clean))
+                c2, p2 = self._walk(stmt.orelse, clean, pending)
+                clean = c1 or c2
+                pending = self._union(p1, p2) if clean else []
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                body_has_effect = self._block_has_effect(stmt.body)
+                c1, p1 = self._walk(stmt.body, clean, [])
+                if body_has_effect:
+                    p1 = []
+                c2, p2 = self._walk(stmt.orelse, clean, pending)
+                clean = clean or c1 or c2
+                pending = self._union(self._union(pending, p1), p2) \
+                    if clean else []
+                continue
+            if isinstance(stmt, ast.With):
+                clean, pending = self._walk(
+                    stmt.body, clean, pending + self._own(stmt, clean))
+                continue
+            if isinstance(stmt, ast.Try):
+                cb, pb = self._walk(stmt.body, clean, pending)
+                cs, ps = cb, pb
+                for handler in stmt.handlers:
+                    ch, ph = self._walk(handler.body, clean, pending)
+                    cs = cs or ch
+                    ps = self._union(ps, ph)
+                if stmt.orelse:
+                    cb, pb = self._walk(stmt.orelse, cb, pb)
+                    cs, ps = cb or cs, self._union(pb, ps)
+                if stmt.finalbody:
+                    cs, ps = self._walk(stmt.finalbody, cs, ps)
+                clean, pending = cs, ps if cs else []
+                continue
+            # plain statement: record its sites, then apply its effects —
+            # after an effectful linear statement no effect-free path
+            # continues past it
+            if clean:
+                pending.extend(self.sites_by_stmt.get(stmt.lineno, []))
+            if self._stmt_has_effect(stmt):
+                clean = False
+                pending = []
+        return clean, pending
+
+    def _own(self, stmt, clean: bool) -> List[MutationSite]:
+        """Sites attached to the header line of a compound statement."""
+        if not clean:
+            return []
+        return list(self.sites_by_stmt.get(stmt.lineno, []))
+
+    def _union(self, a, b):
+        seen = {id(s) for s in a}
+        return a + [s for s in b if id(s) not in seen]
+
+    def _flag_all(self, pending: List[MutationSite]) -> None:
+        for site in pending:
+            if id(site) not in self._flagged_ids:
+                self._flagged_ids.add(id(site))
+                self.flagged.append(site)
+
+    def _block_has_effect(self, stmts) -> bool:
+        for node in ProgramModel._walk_no_defs(stmts):
+            if isinstance(node, ast.stmt) and self._stmt_has_effect(
+                    node, recurse=False):
+                return True
+        return False
+
+    def _stmt_has_effect(self, stmt, recurse: bool = True) -> bool:
+        """Does this single statement (its own expressions, not nested
+        blocks) contain an invalidation sink or an effectful call?"""
+        exprs: List[ast.AST] = []
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                             ast.Expr, ast.Return)):
+            if stmt.value is not None:
+                exprs.append(stmt.value)
+            if isinstance(stmt, ast.AugAssign) \
+                    and isinstance(stmt.target, ast.Attribute) \
+                    and stmt.target.attr in EFFECT_ATTR_BUMPS:
+                return True
+        elif isinstance(stmt, (ast.If, ast.While)):
+            exprs.append(stmt.test)
+        elif isinstance(stmt, ast.For):
+            exprs.append(stmt.iter)
+        elif isinstance(stmt, ast.With):
+            exprs.extend(i.context_expr for i in stmt.items)
+        else:
+            exprs.extend(c for c in ast.iter_child_nodes(stmt)
+                         if isinstance(c, ast.expr))
+        for expr in exprs:
+            for node in ast.walk(expr):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = None
+                if isinstance(node.func, ast.Attribute):
+                    name = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    name = node.func.id
+                if name is None:
+                    continue
+                if name in EFFECT_CALLS:
+                    return True
+                for target in self.model.resolve(name, self.fi):
+                    if target.effectful:
+                        return True
+        return False
+
+
+def uncovered_mutations(model: ProgramModel, fi: FuncInfo
+                        ) -> List[MutationSite]:
+    """VT007 core: mutation sites in ``fi`` with an effect-free path,
+    after the caller-coverage rescue for pure helpers (a function with NO
+    effect anywhere whose every known caller is effectful runs only under
+    its callers' marks)."""
+    if not fi.mutations:
+        return []
+    flagged = PathWalk(model, fi).run()
+    if not flagged:
+        return []
+    if not fi.effectful:
+        callers = [c for c in model.callers.get(fi.name, [])
+                   if c.qualname != fi.qualname]
+        if callers and all(c.effectful for c in callers):
+            return []
+    return flagged

@@ -1,9 +1,13 @@
-"""vclint rules VT001–VT005 — the repo's real failure modes, made lexical.
+"""vclint rules VT001–VT009 — the repo's real failure modes, made lexical.
 
 Each rule mirrors a contract the reference Volcano enforces structurally
 (goroutines, informers, compiled Go) and this rebuild enforces by
 convention; docs/static-analysis.md carries the full rationale and the
-before/after examples per rule.
+before/after examples per rule. VT001–VT006 are per-file pattern checks;
+VT007–VT009 are whole-program effect analyses over the shared model in
+analysis/model.py (call graph, invalidation channels, mutation sites,
+inferred lock/field maps), with analysis/witness.py as their opt-in
+runtime cross-check.
 """
 
 from __future__ import annotations
@@ -1040,3 +1044,289 @@ class DonatedBufferReuse(Rule):
             for p in donating.get(callee, ()):
                 if p < len(node.args) and isinstance(node.args[p], ast.Name):
                     donated[node.args[p].id] = callee
+
+
+# ---------------------------------------------------------------------------
+# VT007 — mutation -> invalidation reachability (whole-program)
+# ---------------------------------------------------------------------------
+
+from volcano_tpu.analysis import model as wpm  # noqa: E402
+
+
+@register_rule
+class MutationInvalidation(Rule):
+    """Snapshot-bearing mutations that can complete without reaching an
+    invalidation channel.
+
+    The correctness of the incremental snapshot (PR 2), the express live
+    axis (PR 7), and the pipeline's speculative solve-ahead (PR 9) all
+    rest on one contract: every mutation of cache/session state marks a
+    SnapshotKeeper dirty-set, bumps an accounting generation
+    (``_acct_gen``/``_status_version``), or moves a fingerprint
+    component. ROADMAP item 2 (device-resident cluster state) turns a
+    missed mark from a stale-snapshot bug into silent host/device
+    divergence, so the contract is machine-checked here: the
+    whole-program model (analysis/model.py) finds every mutation site in
+    the cache/keeper/fingerprint seam and proves each one either shares a
+    path with an invalidation (in-function, callee closure, or — for
+    pure helpers — every caller), or carries an explicit
+    ``# vclint: neutral(<reason>)`` bless documenting WHY the mutation is
+    observable-state-neutral (the PR 9 echo windows)."""
+
+    id = "VT007"
+    title = "snapshot-bearing mutation unreachable from any invalidation"
+    patterns = ("*/scheduler/cache/cache.py", "*/express/*.py",
+                "*/pipeline/*.py", "*/sim/mirror.py")
+
+    def check(self, tree, src, path):
+        findings: List[Finding] = []
+        model = wpm.overlay_model(path, tree)
+        blessed = wpm.neutral_lines(src)
+        norm = path.replace("\\", "/")
+        for fi in model.funcs:
+            if not fi.path.replace("\\", "/") == norm \
+                    and not norm.endswith(fi.path.replace("\\", "/")):
+                continue
+            for site in wpm.uncovered_mutations(model, fi):
+                reason = blessed.get(site.line, blessed.get(site.line - 1))
+                if reason is not None:
+                    if not reason.strip():
+                        findings.append(Finding(
+                            self.id, path, site.line, site.col,
+                            "vclint: neutral() bless without a reason — "
+                            "write '# vclint: neutral(<why this mutation "
+                            "is observable-state-neutral>)'"))
+                    continue
+                findings.append(Finding(
+                    self.id, path, site.line, site.col,
+                    f"mutation '{site.desc}' in '{fi.name}' can complete "
+                    f"without reaching a SnapshotKeeper mark, an "
+                    f"_acct_gen/_status_version bump, or a fingerprint "
+                    f"component — a stale snapshot today, silent "
+                    f"host/device divergence once cluster state is "
+                    f"device-resident; mark it, route it through a "
+                    f"marking effector, or bless it with "
+                    f"'# vclint: neutral(<reason>)'"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# VT008 — whole-program lock discipline
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class WholeProgramLocks(Rule):
+    """Inferred lock/field map violations + dispatch-under-lock through
+    the call graph.
+
+    Generalizes VT003 in both directions: (a) from lexical to INFERRED
+    guarding — a ``self.<field>`` that is written under ``self.<lock>``
+    in one method is that lock's protectee everywhere, so a write outside
+    the lock (in a method not itself transitively lock-safe) is a logical
+    race with whatever thread the locked writers run on; (b) from
+    single-site to INTERPROCEDURAL dispatch checks — PR 9's VT003(d)
+    catches ``solve_*`` lexically inside a ``with self._lock`` body, this
+    rule follows the calls made under ANY held lock (express trigger, HA
+    follow loop, pipeline driver included) into their callee closure and
+    flags a device dispatch or D2H fetch reached through it: the lock
+    would bridge the host mutation path and the device queue, stalling
+    every watch handler behind an async dispatch (or a multi-second
+    implicit compile)."""
+
+    id = "VT008"
+    title = "whole-program lock-discipline violation"
+    patterns = ("*/scheduler/cache/*.py", "*/express/*.py",
+                "*/pipeline/*.py", "*/scheduler/ha.py",
+                "*/scheduler/degrade.py", "*/sim/mirror.py")
+
+    _CLOSURE_DEPTH = 5
+
+    def check(self, tree, src, path):
+        findings: List[Finding] = []
+        model = wpm.overlay_model(path, tree)
+        self._check_fields(model, tree, path, findings)
+        self._check_dispatch_closure(model, path, findings)
+        return findings
+
+    def _check_fields(self, model, tree, path, findings):
+        norm = path.replace("\\", "/")
+        for key, info in model.classes.items():
+            cls_path = key.split("::", 1)[0].replace("\\", "/")
+            if cls_path != norm and not norm.endswith(cls_path):
+                continue
+            for field, lockers in sorted(info.locked_writes.items()):
+                unlocked = info.unlocked_writes.get(field, [])
+                for method, line, col in unlocked:
+                    if method in info.lock_safe or method in lockers:
+                        # written both ways inside one method usually
+                        # means a lexical refactor artifact VT003 owns;
+                        # cross-method evidence is the race signal
+                        continue
+                    findings.append(Finding(
+                        self.id, path, line, col,
+                        f"'{info.name}.{field}' is written under "
+                        f"{sorted(info.locks)[0]} in "
+                        f"{sorted(lockers)[0]}() but mutated without it "
+                        f"in {method}() — the locked writers run on "
+                        f"another thread (watch handlers, the elector), "
+                        f"so this write races them; take the lock or "
+                        f"move the field out of the guarded set"))
+
+    def _check_dispatch_closure(self, model, path, findings):
+        norm = path.replace("\\", "/")
+        for fi in model.funcs:
+            fp = fi.path.replace("\\", "/")
+            if fp != norm and not norm.endswith(fp):
+                continue
+            for node, lock_desc, calls in fi.lock_blocks:
+                direct_lines = {c.lineno for c in calls
+                                if self._dispatch_name(c)
+                                in wpm.DEVICE_DISPATCH}
+                for call in calls:
+                    name = self._dispatch_name(call)
+                    if name is None:
+                        continue
+                    if name in wpm.DEVICE_DISPATCH:
+                        continue  # lexical case: VT003(d) owns it
+                    chain = self._closure_dispatch(model, fi, name)
+                    if chain and call.lineno not in direct_lines:
+                        findings.append(Finding(
+                            self.id, path, call.lineno, call.col_offset,
+                            f"call {name}() under {lock_desc} reaches "
+                            f"device work through "
+                            f"{' -> '.join(chain)} — a dispatch (and "
+                            f"any implicit compile) must never run with "
+                            f"a lock held; snapshot under the lock, "
+                            f"dispatch after it"))
+        return findings
+
+    @staticmethod
+    def _dispatch_name(call: ast.Call) -> Optional[str]:
+        if isinstance(call.func, ast.Attribute):
+            return call.func.attr
+        if isinstance(call.func, ast.Name):
+            return call.func.id
+        return None
+
+    def _closure_dispatch(self, model, from_fn, name):
+        """['refresh', 'stage', 'device_put'] when the named callee's
+        closure reaches a device sink, else None."""
+        seen = set()
+        frontier = [(t, [name]) for t in model.resolve(name, from_fn)]
+        for _ in range(self._CLOSURE_DEPTH):
+            nxt = []
+            for fn, chain in frontier:
+                if fn.qualname in seen:
+                    continue
+                seen.add(fn.qualname)
+                hit = sorted(fn.callees & wpm.DEVICE_DISPATCH)
+                if hit:
+                    return chain + [hit[0]]
+                for callee in sorted(fn.callees):
+                    for target in model.resolve(callee, fn):
+                        nxt.append((target, chain + [callee]))
+            frontier = nxt
+            if not frontier:
+                break
+        return None
+
+
+# ---------------------------------------------------------------------------
+# VT009 — fingerprint completeness
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class FingerprintCompleteness(Rule):
+    """Invalidation channels that the pipeline's speculation fingerprint
+    does not seal.
+
+    The speculative solve-ahead (pipeline/driver.py) is only sound
+    because EVERY way state can move between seal and apply is a
+    component of the sealed fingerprint. VT007's model discovers the
+    channels (every ``*_epoch``/``*_gen``/``generation`` counter an
+    in-scope mutation path bumps); this rule diffs them against the
+    attributes actually read by the fingerprint functions
+    (``SchedulerCache.pipeline_fingerprint`` + ``PipelineDriver.
+    _fingerprint`` and their callee closure) — so adding mutable state
+    with its own invalidation counter, without extending the seal, fails
+    lint instead of becoming a rare stale-commit."""
+
+    id = "VT009"
+    title = "invalidation channel not sealed in the speculation fingerprint"
+    patterns = ("*/scheduler/cache/*.py", "*/express/*.py",
+                "*/pipeline/*.py")
+
+    FINGERPRINT_FUNCS = ("pipeline_fingerprint", "_fingerprint",
+                         "mesh_fingerprint")
+    _CHANNEL_ATTR = re.compile(r"(_epoch|_gen|_seq)$|^(generation|epoch)$")
+    # channels sealed via an equivalent component: keeper_sync moves
+    # job_vers/node_gens records whose divergence the acct/status sums
+    # carry; session_seq is reconcile bookkeeping, not cluster state
+    _EXEMPT = {"session_seq", "dirty_epoch_seen"}
+
+    def check(self, tree, src, path):
+        findings: List[Finding] = []
+        model = wpm.overlay_model(path, tree)
+        sealed = self._sealed_attrs(model, tree, path)
+        if not sealed:
+            return findings  # no fingerprint anywhere: nothing to seal
+        norm = path.replace("\\", "/")
+        for fi in model.funcs:
+            fp = fi.path.replace("\\", "/")
+            if fp != norm and not norm.endswith(fp):
+                continue
+            if fi.name in self.FINGERPRINT_FUNCS:
+                continue
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.AugAssign)
+                        and isinstance(node.target, ast.Attribute)):
+                    continue
+                attr = node.target.attr
+                if not self._CHANNEL_ATTR.search(attr) \
+                        or attr in self._EXEMPT:
+                    continue
+                if attr not in sealed:
+                    findings.append(Finding(
+                        self.id, path, node.lineno, node.col_offset,
+                        f"invalidation channel '{attr}' is bumped here "
+                        f"but never read by the speculation fingerprint "
+                        f"({' / '.join(self.FINGERPRINT_FUNCS[:2])}) — "
+                        f"a speculative solve sealed before this bump "
+                        f"would commit against state it never saw; add "
+                        f"the channel to the sealed tuple"))
+        return findings
+
+    def _sealed_attrs(self, model, tree, path):
+        """Attribute names read inside the fingerprint functions and
+        their (bounded) callee closure — file-local definitions first,
+        then the package's."""
+        roots: List[wpm.FuncInfo] = []
+        local = {fi.name: fi for fi in model.funcs
+                 if fi.path == path or
+                 path.replace("\\", "/").endswith(
+                     fi.path.replace("\\", "/"))}
+        for name in self.FINGERPRINT_FUNCS:
+            if name in local:
+                roots.append(local[name])
+            else:
+                roots.extend(model.by_short.get(name, []))
+        sealed: Set[str] = set()
+        seen: Set[str] = set()
+        frontier = list(roots)
+        for _ in range(3):
+            nxt: List[wpm.FuncInfo] = []
+            for fn in frontier:
+                if fn.qualname in seen:
+                    continue
+                seen.add(fn.qualname)
+                for node in ast.walk(fn.node):
+                    if isinstance(node, ast.Attribute):
+                        sealed.add(node.attr)
+                for callee in sorted(fn.callees):
+                    nxt.extend(model.resolve(callee, fn))
+            frontier = nxt
+            if not frontier:
+                break
+        return sealed

@@ -1,0 +1,302 @@
+"""Runtime lock-witness shim — the dynamic half of VT007/VT008.
+
+The static rules prove the mutation->invalidation and lock/field
+contracts LEXICALLY; this shim validates the same model EMPIRICALLY, so
+the tier-1 sim scenarios cross-check what the analysis claims. Opt-in
+via ``VOLCANO_TPU_WITNESS=1`` (the sim harness auto-installs it on every
+cache it builds); zero-cost when off.
+
+Three instruments per SchedulerCache:
+
+- **LockWitness** replaces ``cache._lock`` with an ownership-tracking
+  wrapper (same RLock semantics), so "is the cache lock held by this
+  thread?" becomes a checkable predicate;
+- **GuardedDict** replaces the jobs/nodes/queues containers: any
+  structural mutation (insert, pop, clear, ...) without the cache lock
+  held raises ``WitnessViolation`` at the offending line — the runtime
+  enforcement of VT008's inferred lock/field map. Keeper mark/sync
+  methods are wrapped with the same held-lock assertion (the
+  "marks are called under the cache lock" contract every mark docstring
+  states);
+- **check_session()** is the mutation->invalidation witness: it records
+  every cache twin's ``_acct_gen``/``_status_version`` at the previous
+  boundary and, at the next one, requires every version that moved to be
+  explained by a keeper mark (observed through a DirtyShadow), a
+  bulk-flush sync, or a wholesale invalidation. An unexplained movement
+  is exactly the "unmarked mutation" class VT007 models — a stale
+  snapshot today, silent host/device divergence once cluster state is
+  device-resident (ROADMAP item 2).
+
+The shim disables the native effector mirror (``cache._fast_mirror``)
+so every bind/evict flows through the Python oracle path the witness can
+observe; the native bulk flush stays on (its keeper syncs are visible).
+It never dispatches device work, so ``assert_no_compiles`` behaves
+identically with the witness armed — tested in tests/test_witness.py.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Set
+
+
+def enabled() -> bool:
+    return os.environ.get("VOLCANO_TPU_WITNESS", "") not in ("", "0")
+
+
+class WitnessViolation(AssertionError):
+    pass
+
+
+class LockWitness:
+    """RLock wrapper tracking the owning thread + depth."""
+
+    def __init__(self, inner=None):
+        self._inner = inner if inner is not None else threading.RLock()
+        self._owner: Optional[int] = None
+        self._depth = 0
+
+    def acquire(self, *a, **kw):
+        got = self._inner.acquire(*a, **kw)
+        if got:
+            self._owner = threading.get_ident()
+            self._depth += 1
+        return got
+
+    def release(self):
+        self._depth -= 1
+        if self._depth == 0:
+            self._owner = None
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def held(self) -> bool:
+        return self._owner == threading.get_ident() and self._depth > 0
+
+
+class GuardedDict(dict):
+    """dict whose structural mutations assert the witness lock is held
+    by the current thread (reads stay native-speed)."""
+
+    __slots__ = ("_witness", "_label")
+
+    def __init__(self, witness: "CacheWitness", label: str, *a, **kw):
+        super().__init__(*a, **kw)
+        self._witness = witness
+        self._label = label
+
+    def _assert_locked(self, op: str) -> None:
+        self._witness.note_guarded_access(self._label, op)
+
+    def __setitem__(self, key, value):
+        self._assert_locked("set")
+        super().__setitem__(key, value)
+
+    def __delitem__(self, key):
+        self._assert_locked("del")
+        super().__delitem__(key)
+
+    def pop(self, *a, **kw):
+        self._assert_locked("pop")
+        return super().pop(*a, **kw)
+
+    def popitem(self):
+        self._assert_locked("popitem")
+        return super().popitem()
+
+    def clear(self):
+        self._assert_locked("clear")
+        super().clear()
+
+    def update(self, *a, **kw):
+        self._assert_locked("update")
+        super().update(*a, **kw)
+
+    def setdefault(self, *a, **kw):
+        self._assert_locked("setdefault")
+        return super().setdefault(*a, **kw)
+
+
+class CacheWitness:
+    """The installed witness for one SchedulerCache."""
+
+    _KEEPER_MARKS = ("mark_job", "mark_node", "mark_meta", "invalidate")
+
+    def __init__(self, cache, strict: bool = True):
+        self.cache = cache
+        self.strict = strict
+        self.violations: List[Dict] = []
+        self.checks = 0
+        self.guarded_ops = 0
+        self.mark_asserts = 0
+        self._lock = LockWitness(getattr(cache, "_lock", None))
+        cache._lock = self._lock
+        # the Python effector mirror is the oracle the witness observes;
+        # None (not False) permanently declines the native rebuild
+        cache._fast_mirror = None
+        # independent consumers of the keeper's marks: the witness's own
+        # shadow sees exactly what the express lane's would
+        self.shadow = cache.snap_keeper.add_shadow()
+        self._synced_jobs: Set[str] = set()
+        self._synced_nodes: Set[str] = set()
+        self._wrap_keeper(cache.snap_keeper)
+        cache.jobs = GuardedDict(self, "jobs", cache.jobs)
+        cache.nodes = GuardedDict(self, "nodes", cache.nodes)
+        cache.queues = GuardedDict(self, "queues", cache.queues)
+        self._node_gens: Dict[str, int] = {}
+        self._job_vers: Dict[str, int] = {}
+        self._shadow_generation = self.shadow.generation
+        self._rebase()
+        cache._witness = self
+
+    # -- instrumentation ---------------------------------------------------
+
+    def note_guarded_access(self, label: str, op: str) -> None:
+        self.guarded_ops += 1
+        if not self._lock.held():
+            self._violate(
+                "out_of_lock_write",
+                f"cache.{label} mutated ({op}) without the cache lock "
+                f"held by this thread — the locked writers (watch "
+                f"handlers, effectors) race this write")
+
+    def _wrap_keeper(self, keeper) -> None:
+        witness = self
+
+        def wrap_mark(name, fn):
+            def wrapped(*a, **kw):
+                witness.mark_asserts += 1
+                if not witness._lock.held():
+                    witness._violate(
+                        "mark_outside_lock",
+                        f"snap_keeper.{name} called without the cache "
+                        f"lock — marks are dirty-set mutations shared "
+                        f"with every consumer shadow")
+                return fn(*a, **kw)
+            return wrapped
+
+        for name in self._KEEPER_MARKS:
+            setattr(keeper, name, wrap_mark(name, getattr(keeper, name)))
+
+        orig_sync_job = keeper.sync_job
+        orig_sync_node = keeper.sync_node
+
+        def sync_job(uid, version):
+            witness._synced_jobs.add(uid)
+            return orig_sync_job(uid, version)
+
+        def sync_node(name, gen):
+            witness._synced_nodes.add(name)
+            return orig_sync_node(name, gen)
+
+        keeper.sync_job = sync_job
+        keeper.sync_node = sync_node
+
+    # -- the mutation->invalidation check ----------------------------------
+
+    def _rebase(self) -> None:
+        self._node_gens = {name: nd._acct_gen
+                           for name, nd in dict.items(self.cache.nodes)}
+        self._job_vers = {uid: job._status_version
+                          for uid, job in dict.items(self.cache.jobs)}
+        self.shadow.dirty_jobs.clear()
+        self.shadow.dirty_nodes.clear()
+        self._synced_jobs.clear()
+        self._synced_nodes.clear()
+        self._shadow_generation = self.shadow.generation
+
+    def check_session(self) -> int:
+        """Session-boundary probe: every cache twin whose accounting
+        version moved since the last boundary must be explained by a
+        mark, a flush sync, or a wholesale invalidation. Returns the
+        number of unexplained movements (0 in a correct build)."""
+        cache = self.cache
+        bad = 0
+        with self._lock:
+            self.checks += 1
+            if self.shadow.generation != self._shadow_generation:
+                # wholesale invalidation: everything is re-cloned anyway
+                self._rebase()
+                return 0
+            marked_n = self.shadow.dirty_nodes
+            marked_j = self.shadow.dirty_jobs
+            nodes = dict.items(cache.nodes)
+            for name, nd in nodes:
+                old = self._node_gens.get(name)
+                moved = old is None or nd._acct_gen != old
+                if moved and name not in marked_n \
+                        and name not in self._synced_nodes:
+                    bad += 1
+                    self._violate(
+                        "unmarked_mutation",
+                        f"node '{name}' accounting generation moved "
+                        f"({old} -> {nd._acct_gen}) with no keeper mark "
+                        f"or flush sync — the next incremental snapshot "
+                        f"self-heals, but a sealed speculative solve "
+                        f"would only survive via the belt-and-braces "
+                        f"acct sum", raise_now=False)
+            for name in self._node_gens:
+                if name not in cache.nodes and name not in marked_n:
+                    bad += 1
+                    self._violate(
+                        "unmarked_mutation",
+                        f"node '{name}' vanished from the cache with no "
+                        f"keeper mark", raise_now=False)
+            for uid, job in dict.items(cache.jobs):
+                old = self._job_vers.get(uid)
+                moved = old is None or job._status_version != old
+                if moved and uid not in marked_j \
+                        and uid not in self._synced_jobs:
+                    bad += 1
+                    self._violate(
+                        "unmarked_mutation",
+                        f"job '{uid}' status version moved "
+                        f"({old} -> {job._status_version}) with no "
+                        f"keeper mark or flush sync", raise_now=False)
+            for uid in self._job_vers:
+                if uid not in cache.jobs and uid not in marked_j:
+                    bad += 1
+                    self._violate(
+                        "unmarked_mutation",
+                        f"job '{uid}' vanished from the cache with no "
+                        f"keeper mark", raise_now=False)
+            self._rebase()
+        if bad and self.strict:
+            raise WitnessViolation(
+                "; ".join(v["message"] for v in self.violations[-bad:]))
+        return bad
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _violate(self, kind: str, message: str,
+                 raise_now: bool = True) -> None:
+        self.violations.append({"kind": kind, "message": message})
+        if self.strict and raise_now:
+            raise WitnessViolation(message)
+
+    def summary(self) -> Dict:
+        return {"checks": self.checks,
+                "guarded_ops": self.guarded_ops,
+                "mark_asserts": self.mark_asserts,
+                "violations": len(self.violations),
+                "kinds": sorted({v["kind"] for v in self.violations})}
+
+
+def install(cache, strict: bool = True) -> CacheWitness:
+    """Arm the witness on a cache (idempotent)."""
+    existing = getattr(cache, "_witness", None)
+    if existing is not None:
+        return existing
+    return CacheWitness(cache, strict=strict)
+
+
+def get(cache) -> Optional[CacheWitness]:
+    return getattr(cache, "_witness", None)

@@ -80,12 +80,12 @@ def reconcile_session(ssn) -> Optional[Dict]:
                 missing += 1  # moved by something with authority already
         if not live:
             stats["terminal"] += 1
-            lane.counters["terminal"] += 1
+            lane._count("terminal", 1)
             continue
         verdict = _verdict(ssn, job, token, missing)
         if verdict is None:
             stats["confirmed"] += 1
-            lane.counters["reconciled"] += 1
+            lane._count("reconciled", 1)
             continue
         stmt = ssn.statement()
         for task, node_name in live:
@@ -95,7 +95,7 @@ def reconcile_session(ssn) -> Optional[Dict]:
         lane.denylist.add(job_uid)
         stats["reverted"] += 1
         stats["reverted_tasks"] += len(live)
-        lane.counters["reverted"] += len(live)
+        lane._count("reverted", len(live))
         logger.info("express revert %s (%d tasks): %s",
                     job_uid, len(live), verdict)
     if stats["reverted_tasks"]:

@@ -213,6 +213,15 @@ class ExpressLane:
                 self._queue.append(job_uid)
         self.wake.set()
 
+    def _count(self, key: str, n: int) -> None:
+        """Counter bumps under _qlock: note_arrival increments
+        ``counters`` from the watch-handler thread, the lane thread from
+        run_once — an unlocked read-modify-write here would race it
+        (VT008's inferred lock/field map; the witness shim asserts the
+        same map at runtime)."""
+        with self._qlock:
+            self.counters[key] += n
+
     def has_pending(self) -> bool:
         return bool(self._queue)
 
@@ -296,7 +305,7 @@ class ExpressLane:
         if reason is not None:
             rep.deferred = len(uids)
             rep.reasons[reason] = len(uids)
-            self.counters["deferred"] += len(uids)
+            self._count("deferred", len(uids))
             metrics.register_express_deferred(len(uids))
             return rep.as_dict()
         try:
@@ -307,7 +316,7 @@ class ExpressLane:
             # breaker turns PERSISTENT failure into an auto-park
             # (express_disabled rung) instead of a doomed dispatch per wake
             logger.exception("express batch failed; deferring to session")
-            self.counters["errors"] += 1
+            self._count("errors", 1)
             self.breaker.record_failure()
             rep.deferred += rep.queued - rep.placed - rep.deferred
             rep.reasons["error"] = rep.reasons.get("error", 0) + 1
@@ -345,7 +354,7 @@ class ExpressLane:
                 total += len(tasks)
             rows = self.state.refresh() if jobs else []
         if not jobs:
-            self.counters["deferred"] += rep.deferred
+            self._count("deferred", rep.deferred)
             if rep.deferred:
                 metrics.register_express_deferred(rep.deferred)
             return
@@ -365,9 +374,10 @@ class ExpressLane:
         rep.placed = placed
         rep.deferred += deferred
         rep.batches = 1
-        self.counters["placed"] += placed
-        self.counters["deferred"] += rep.deferred
-        self.counters["batches"] += 1
+        with self._qlock:
+            self.counters["placed"] += placed
+            self.counters["deferred"] += rep.deferred
+            self.counters["batches"] += 1
         if placed:
             metrics.register_express_placements(placed)
         if rep.deferred:

@@ -1192,6 +1192,17 @@ def _profile(ssn) -> dict:
     return p.profile if p is not None else {}
 
 
+def _note_fallback(prof: dict, key: str, reason: str) -> None:
+    """Record an honesty fallback in the session profile AND the
+    process-wide fallback counter (metrics.register_fallback) — the sim
+    auditor budgets these as rates, so an envelope regression fails the
+    gate like a parity regression (ROADMAP item 4)."""
+    from volcano_tpu.scheduler import metrics
+
+    prof[key + "_fallback"] = reason
+    metrics.register_fallback(key)
+
+
 def _common_view(ssn, view=None):
     if os.environ.get("VOLCANO_TPU_EVICT", "1") == "0":
         raise _Unsupported("VOLCANO_TPU_EVICT=0")
@@ -1274,7 +1285,14 @@ def build(ssn, kind: str):
             return _BackfillPlan(ssn)
         return _EvictPlan(ssn, kind)
     except _Unsupported as e:
-        prof[f"evict_{kind}_fallback"] = str(e)
+        reason = str(e)
+        if reason in ("VOLCANO_TPU_EVICT=0", "tpuscore off"):
+            # the device path is not armed at all (serial conf / env
+            # oracle) — a mode choice, not an envelope miss: keep the
+            # profile reason but do not charge the fallback-rate budget
+            prof[f"evict_{kind}_fallback"] = reason
+        else:
+            _note_fallback(prof, f"evict_{kind}", reason)
         return None
 
 
@@ -1732,7 +1750,7 @@ class _EvictPlan:
         except Exception as e:  # any device/compile failure -> old path
             logger.exception("batched %s solve failed; falling back",
                              self.kind)
-            prof[key + "_fallback"] = f"solve error: {e}"
+            _note_fallback(prof, key, f"solve error: {e}")
             return False
         return self.consume(out, time.perf_counter() - t0)
 
@@ -1753,7 +1771,8 @@ class _EvictPlan:
             int(tail[0]), int(tail[1]), int(tail[2]), int(tail[3]),
             int(tail[4]), int(tail[5]))
         if fail:
-            prof[key + "_fallback"] = "kernel step/log budget exhausted"
+            _note_fallback(prof, key,
+                           "kernel step/log budget exhausted")
             return False
         if underflow:
             from volcano_tpu.utils.assertions import panic_enabled
@@ -1762,8 +1781,8 @@ class _EvictPlan:
                 # the serial walk raises AssertionViolation at the
                 # offending claimee; rerun it so panic mode fails
                 # identically loudly (nothing was applied)
-                prof[key + "_fallback"] = \
-                    "resource underflow under panic mode"
+                _note_fallback(prof, key,
+                               "resource underflow under panic mode")
                 return False
         log = out[:log_len * 3].reshape(log_len, 3)
         self._replay(log, victims, attempts, rr, kind=kind)
@@ -1922,7 +1941,7 @@ class _BackfillPlan:
             assign = wait()
         except Exception as e:
             logger.exception("batched backfill solve failed; falling back")
-            prof["evict_backfill_fallback"] = f"solve error: {e}"
+            _note_fallback(prof, "evict_backfill", f"solve error: {e}")
             return False
         return self.consume(assign, time.perf_counter() - t0,
                             all_nodes=all_nodes)

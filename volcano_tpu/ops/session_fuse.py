@@ -387,6 +387,15 @@ def _per_action(ssn, names: List[str], action_ms: Dict[str, float]) -> None:
         action_ms[name] = round((time.perf_counter() - t0) * 1e3, 3)
 
 
+def _note_fuse_fallback(prof: dict, reason: str) -> None:
+    """Profile record + process-wide fallback counter (the sim auditor
+    budgets fuse-fallback RATES per scenario, ROADMAP item 4)."""
+    from volcano_tpu.scheduler import metrics
+
+    prof["fuse_fallback"] = reason
+    metrics.register_fallback("fuse")
+
+
 def _fuse_or_fallback(ssn, chain: List[str],
                       action_ms: Dict[str, float]) -> None:
     """Attempt the fused chain; any envelope miss records `fuse_fallback`
@@ -402,8 +411,8 @@ def _fuse_or_fallback(ssn, chain: List[str],
         # sub-threshold / unknown-plugin / encoder-fallback sessions run
         # the per-action path (allocate's own fallback ladder applies);
         # _prepare already recorded the reason
-        prof["fuse_fallback"] = prof.get(
-            "fallback", "allocate not in packed rounds mode")
+        _note_fuse_fallback(prof, prof.get(
+            "fallback", "allocate not in packed rounds mode"))
         _per_action(ssn, chain, action_ms)
         return
     enc = prep["enc"]
@@ -433,7 +442,7 @@ def _fuse_or_fallback(ssn, chain: List[str],
             if plan.trivial:
                 reason = "no pre-action preemptor candidates"
     if reason is not None:
-        prof["fuse_fallback"] = reason
+        _note_fuse_fallback(prof, reason)
         _per_action(ssn, chain, action_ms)
         return
 
@@ -441,7 +450,7 @@ def _fuse_or_fallback(ssn, chain: List[str],
         _run_fused(ssn, chain, action_ms, prep, plan, bf, t_chain)
     except Exception as e:  # pragma: no cover - device/compile failure
         logger.exception("fused session dispatch failed; falling back")
-        prof["fuse_fallback"] = f"fused dispatch error: {e}"
+        _note_fuse_fallback(prof, f"fused dispatch error: {e}")
         _per_action(ssn, [n for n in chain if n not in action_ms],
                     action_ms)
 
@@ -554,8 +563,8 @@ def _run_fused(ssn, chain, action_ms, prep, plan, bf, t_chain) -> None:
         # the serial residue pass just mutated session state the remaining
         # device stages never saw: their results are invalid — discard
         # them and run the rest per-action (nothing else was applied)
-        prof["fuse_fallback"] = "allocate residue retry invalidated " \
-                                "the fused evict stages"
+        _note_fuse_fallback(prof, "allocate residue retry invalidated "
+                                  "the fused evict stages")
         _per_action(ssn, [n for n in chain if n != "allocate"], action_ms)
         return
 

@@ -199,6 +199,10 @@ class PipelineDriver:
             return False, "generation"
         if o_cache[0] != n_cache[0]:
             return False, "watch_delta"
+        if o_cache[5:7] != n_cache[5:7]:
+            # job-side belt-and-braces (VT009): an unmarked job mutation
+            # moved the status-version sum without touching dirty epoch
+            return False, "job_version"
         return False, "acct_gen"
 
     # -- cycle entry ---------------------------------------------------------

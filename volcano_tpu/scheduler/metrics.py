@@ -165,6 +165,16 @@ class Registry:
             "Host work overlapped with an in-flight speculative device "
             "solve, per committed cycle",
             [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0])
+        # device-path honesty fallbacks (ROADMAP item 4): every envelope
+        # miss that dropped a session/action back to the serial oracle,
+        # labeled by kind (fuse, evict_preempt, evict_reclaim,
+        # evict_backfill). The sim auditor audits these as RATES against
+        # per-scenario budgets, so an envelope regression fails the gate
+        # exactly like a parity regression
+        self.device_fallbacks = Counter(
+            f"{_NAMESPACE}_device_fallbacks_total",
+            "Device-path honesty fallbacks to the serial oracle, by kind",
+            ("kind",))
         # instantaneous cluster levels (set each cycle; the sim harness and
         # the scheduler loop both publish through these)
         self.pending_pods = Gauge(
@@ -286,6 +296,10 @@ def set_degraded_mode(rung: str, active: bool) -> None:
 
 def set_pipeline_sessions_per_sec(v: float) -> None:
     registry().pipeline_sessions_per_sec.set(v)
+
+
+def register_fallback(kind: str, n: int = 1) -> None:
+    registry().device_fallbacks.inc((kind,), n)
 
 
 def register_pipeline_spec_discard(reason: str, n: int = 1) -> None:

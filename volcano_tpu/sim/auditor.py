@@ -135,6 +135,7 @@ class Auditor:
         found.extend(self._check_event_consistency())
         found.extend(self._check_express())
         found.extend(self._check_pipeline())
+        found.extend(self._check_fallback_budgets())
         if getattr(self.sim, "ha_enabled", False):
             found.extend(self._check_ha_fencing())
             found.extend(self._check_ha_takeover())
@@ -420,6 +421,52 @@ class Auditor:
                     {"sealed_epoch": sealed_epoch,
                      "commit_epoch": lane.commit_epoch,
                      "outstanding": sorted(lane.outstanding)[:20]}))
+        return out
+
+    def _check_fallback_budgets(self) -> List[Violation]:
+        """Envelope budgets (ROADMAP item 4): the scenario's
+        ``audit.budgets`` pins a maximum rate per fallback family —
+        ``fuse_fallback_rate`` / ``evict_fallback_rate`` (per session),
+        ``express_deferral_rate`` (per arrival),
+        ``pipeline_spec_discard_rate`` (per dispatch). A rate above its
+        budget is a gate failure exactly like a parity violation: the
+        honesty fallbacks are a tax on real traffic, and this is the
+        standing meter that keeps them a rounding error. Each entry is a
+        plain max rate or ``{max: <rate>, min_n: <samples>}``; the check
+        stays silent until the denominator reaches ``min_n`` (default
+        25) so a cold run's transient can't fail a budget it never got
+        to amortize."""
+        out: List[Violation] = []
+        budgets = self.cfg.get("budgets") or {}
+        if not budgets:
+            return out
+        rates = self.sim.fallback_rates()
+        denominators = {
+            "fuse_fallback_rate": rates.get("sessions", 0),
+            "evict_fallback_rate": rates.get("sessions", 0),
+            "express_deferral_rate": rates.get("express_arrivals", 0),
+            "pipeline_spec_discard_rate": rates.get(
+                "pipeline_spec_dispatched", 0),
+        }
+        for name in sorted(budgets):
+            spec = budgets[name]
+            if isinstance(spec, dict):
+                limit = float(spec.get("max", 1.0))
+                min_n = int(spec.get("min_n", 25))
+            else:
+                limit, min_n = float(spec), 25
+            rate = rates.get(name)
+            n = denominators.get(name, 0)
+            if rate is None or n < min_n:
+                continue
+            if rate > limit + 1e-12:
+                out.append(Violation(
+                    "fallback_budget", name,
+                    f"{name} = {rate} exceeds the scenario budget "
+                    f"{limit} over {n} samples — the envelope regressed "
+                    f"(see fallbacks counts in the run summary)",
+                    {"rate": rate, "budget": limit, "samples": n,
+                     "fallbacks": rates}))
         return out
 
     def _check_ha_fencing(self) -> List[Violation]:

@@ -169,6 +169,11 @@ class SimCluster:
         self._pending_promote = False
         self._standby_cache = None
         self._standby_follows = 0
+        # runtime lock-witness (VOLCANO_TPU_WITNESS=1): every cache this
+        # run builds gets the shim; the session slice probes it
+        from volcano_tpu.analysis import witness as _witness_mod
+
+        self._witness_on = _witness_mod.enabled()
         self._build_controllers()
         self._build_scheduler()
         if self.ha_enabled:
@@ -226,6 +231,14 @@ class SimCluster:
                                    waits=self._task_wait_s,
                                    pre_bind=self._on_bind_attempt),
             evictor=_CountingEvictor(self.store, self.counters))
+        if self._witness_on:
+            # VOLCANO_TPU_WITNESS=1: arm the lock-witness shim BEFORE the
+            # watch replay so every mark/mutation this cache ever performs
+            # runs under its assertions (analysis/witness.py — the
+            # runtime cross-check of the VT007/VT008 static model)
+            from volcano_tpu.analysis import witness as witness_mod
+
+            witness_mod.install(cache)
         cache.run()
         cache.wait_for_cache_sync()
         self._all_caches.append(cache)
@@ -339,6 +352,12 @@ class SimCluster:
         if cache is None:
             return "no-standby"
         cache.snapshot()
+        if self._witness_on:
+            from volcano_tpu.analysis import witness as witness_mod
+
+            w = witness_mod.get(cache)
+            if w is not None:
+                w.check_session()
         self._standby_follows += 1
         stats = cache.snap_keeper.stats
         self._schedule_standby()
@@ -528,6 +547,16 @@ class SimCluster:
         self._last_stats = stats
         metrics.set_pending_pods(stats["pending"])
         self._publish_queue_depth()
+
+        if self._witness_on:
+            # session-boundary probe: every cache-twin version that moved
+            # this slice must be explained by a mark/sync (strict — an
+            # unmarked mutation crashes the run at the offending slice)
+            from volcano_tpu.analysis import witness as witness_mod
+
+            w = witness_mod.get(self.cache)
+            if w is not None:
+                w.check_session()
 
         faults = self.chaos.mirror_faults()
         for mirror in self.mirrors:
@@ -738,6 +767,61 @@ class SimCluster:
         wall = time.perf_counter() - wall0
         return self._summary(wall)
 
+    def fallback_rates(self) -> Dict:
+        """Envelope honesty as RATES (ROADMAP item 4): device-path
+        fallbacks per session, express deferrals per arrival, speculation
+        discards per dispatch. One definition shared by the summary tail
+        and the auditor's budget gate."""
+        reg = metrics.registry()
+        sessions = max(self.sessions_done, 1)
+        counts = {kind: int(reg.device_fallbacks.get((kind,)))
+                  for kind in ("fuse", "evict_preempt", "evict_reclaim",
+                               "evict_backfill")}
+        evict_total = (counts["evict_preempt"] + counts["evict_reclaim"]
+                       + counts["evict_backfill"])
+        out: Dict = {
+            "counts": counts,
+            "sessions": self.sessions_done,
+            "fuse_fallback_rate": round(counts["fuse"] / sessions, 4),
+            "evict_fallback_rate": round(evict_total / sessions, 4),
+        }
+        lane = self.express_lane
+        if lane is not None:
+            arrivals = lane.counters["arrivals"]
+            out["express_arrivals"] = arrivals
+            out["express_deferrals"] = lane.counters["deferred"]
+            out["express_deferral_rate"] = round(
+                lane.counters["deferred"] / max(arrivals, 1), 4)
+        if self.pipeline_driver is not None or self._pipeline_stats_total:
+            stats = self.pipeline_stats_combined()
+            dispatched = stats.get("spec_dispatched", 0)
+            out["pipeline_spec_dispatched"] = dispatched
+            out["pipeline_spec_discards"] = stats.get("spec_discarded", 0)
+            out["pipeline_spec_discard_rate"] = round(
+                stats.get("spec_discarded", 0) / max(dispatched, 1), 4)
+        return out
+
+    def _witness_summary(self) -> Dict:
+        """Aggregate witness accounting across every cache generation
+        (restarts + standbys), mirroring all_caches() fence balance."""
+        from volcano_tpu.analysis import witness as witness_mod
+
+        total = {"checks": 0, "guarded_ops": 0, "mark_asserts": 0,
+                 "violations": 0, "kinds": []}
+        kinds: set = set()
+        for cache in self._all_caches:
+            w = witness_mod.get(cache)
+            if w is None:
+                continue
+            s = w.summary()
+            total["checks"] += s["checks"]
+            total["guarded_ops"] += s["guarded_ops"]
+            total["mark_asserts"] += s["mark_asserts"]
+            total["violations"] += s["violations"]
+            kinds.update(s["kinds"])
+        total["kinds"] = sorted(kinds)
+        return total
+
     def _summary(self, wall_s: float) -> Dict:
         warmup = min(3, len(self._session_compiles))
         jobs = self.workload
@@ -785,6 +869,9 @@ class SimCluster:
                 "after_warmup": sum(self._session_compiles[warmup:]),
                 "per_session": self._session_compiles[:64],
             },
+            "fallbacks": self.fallback_rates(),
+            "witness": (self._witness_summary()
+                        if self._witness_on else None),
             "event_log_hash": self.engine.log_hash(),
             "log_records": self.engine.log_records,
             "events_run": self.engine.events_run,

@@ -797,6 +797,156 @@ def _storm_headline(scale: float, seed: int = 7, duration: float = 60.0):
     }
 
 
+def _front_door_headline(scale: float = 0.5, seed: int = 7,
+                         duration: float = 60.0):
+    """front_door_storm headline from the sim harness (ROADMAP item 3's
+    admission column): offered submissions/sec vs admitted-and-scheduled
+    under a heavy-tailed storm, with the shed/coalesce rates the auditor
+    budgets riding along."""
+    from volcano_tpu.sim.harness import SimCluster
+    from volcano_tpu.sim.workload import load_scenario, scale_scenario
+
+    cfg = scale_scenario(load_scenario("front_door_storm"), scale)
+    sim = SimCluster(cfg, seed=seed, repro_dir=None)
+    s = sim.run(duration=duration)
+    fd = s.get("front_door") or {}
+    fb = s.get("fallbacks") or {}
+    return {
+        "submitted_per_sim_s": fd.get("submitted_per_sim_s"),
+        "admitted_per_sim_s": fd.get("admitted_per_sim_s"),
+        "binds": s["binds"],
+        "sessions_per_sec": s["sessions_per_sec"],
+        "admission_shed_rate": fb.get("admission_shed_rate"),
+        "watch_coalesce_rate": fb.get("watch_coalesce_rate"),
+        "watch_demotions": ((fd.get("watch") or {}).get(
+            "counters") or {}).get("demotions"),
+        "violations": s["audit"]["violations"],
+        "scale": scale,
+    }
+
+
+def run_fanout_bench(watchers: int = 10000, batches: int = 40,
+                     churn: int = 96, cap: int = 4096,
+                     slow_every: int = 500, slow_stride: int = 8,
+                     sample: int = 64, pods: int = 512):
+    """Watch fan-out at 10k+ concurrent watchers over ONE shared journal.
+
+    Synchronous (no threads — the shared-slice fast path is what's under
+    test): each batch mutates ``churn`` pods, then every watcher polls
+    once through the flow-control layer. Every ``slow_every``-th watcher
+    only polls every ``slow_stride`` batches — the laggard tail that must
+    ride bounded retention and demotion-to-resync instead of pinning the
+    ring. Reports per-event delivery latency percentiles (append-stamp to
+    delivery, sampled over the first ``sample`` watchers), throughput,
+    and the per-watcher memory footprint — cursor + counters only, which
+    is the O(events + watchers) proof."""
+    import copy
+
+    from volcano_tpu.api import objects
+    from volcano_tpu.scheduler.util.test_utils import build_pod
+    from volcano_tpu.store.flowcontrol import WatchFanout, WatcherState
+    from volcano_tpu.store.gateway import _WatchJournal
+    from volcano_tpu.store.store import Store
+
+    store = Store()
+    journal = _WatchJournal(store, "Pod", cap=cap)
+    fanout = WatchFanout(journal, demote_lag=2 * cap, pin_factor=4)
+
+    def make(i):
+        pod = build_pod("bench", f"pod-{i:06d}", "",
+                        objects.POD_PHASE_PENDING,
+                        {"cpu": "100m", "memory": "64Mi"}, "")
+        pod.metadata.ensure_identity()
+        return pod
+
+    live = []
+    for i in range(pods):
+        pod = make(i)
+        store.create(pod)
+        live.append(pod)
+    cursors = [0] * watchers
+    classes = ["interactive" if i % 3 == 0 else "batch"
+               for i in range(watchers)]
+    latencies = []
+    delivered = resyncs = 0
+    next_pod = pods
+    wall0 = time.perf_counter()
+    for batch in range(batches):
+        for k in range(churn):
+            idx = (batch * churn + k) % len(live)
+            if k % 7 == 0:
+                pod = make(next_pod)
+                next_pod += 1
+                store.create(pod)
+                live.append(pod)
+            else:
+                cur = store.try_get("Pod", "bench",
+                                    live[idx].metadata.name)
+                if cur is None:
+                    continue
+                upd = copy.deepcopy(cur)
+                upd.metadata.annotations["b"] = str(batch)
+                store.update(upd)
+        poll_t = time.monotonic()
+        for i in range(watchers):
+            if slow_every and i % slow_every == slow_every - 1 \
+                    and batch % slow_stride != 0:
+                continue  # the deliberately slow tail
+            events, nxt, reset = fanout.poll_for(
+                f"w{i:05d}", cursors[i], 0.0, cls=classes[i])
+            cursors[i] = nxt
+            if reset:
+                resyncs += 1
+                continue
+            delivered += len(events)
+            if i < sample:
+                latencies.extend(poll_t - e["ts"] for e in events
+                                 if "ts" in e)
+    wall = time.perf_counter() - wall0
+    latencies.sort()
+
+    def pct(q):
+        if not latencies:
+            return 0.0
+        return round(
+            latencies[min(int(q * len(latencies)), len(latencies) - 1)]
+            * 1e3, 3)
+
+    ws_bytes = sys.getsizeof(WatcherState("x", "batch", 0)) \
+        + sum(sys.getsizeof(getattr(WatcherState("x", "batch", 0), s))
+              for s in WatcherState.__slots__)
+    stats = fanout.watch_stats()
+    return {
+        "watchers": watchers,
+        "batches": batches,
+        "events_appended": stats["journal"]["appended"],
+        "deliveries": delivered,
+        "fanout_p50_ms": pct(0.50),
+        "fanout_p99_ms": pct(0.99),
+        "polls_per_sec": round(watchers * batches / wall, 1),
+        "deliveries_per_sec": round(delivered / wall, 1),
+        "coalesced": stats["counters"]["coalesced"],
+        "demotions": stats["counters"]["demotions"],
+        "resyncs": resyncs,
+        "journal_peak_occupancy": stats["journal"]["peak_occupancy"],
+        "journal_hard_cap": stats["journal"]["hard_cap"],
+        "per_watcher_state_bytes": ws_bytes,
+        "wall_s": round(wall, 3),
+        "pid_rss_mb": _rss_mb(),
+    }
+
+
+def _rss_mb():
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return round(int(line.split()[1]) / 1024.0, 1)
+    except OSError:
+        pass
+    return None
+
+
 def _standing_mesh_curve(scale: float):
     """The standing cfg7 mesh curve recorded in every all-configs run —
     in a SUBPROCESS: the CPU proxy needs the 8-virtual-device XLA flag,
@@ -970,6 +1120,16 @@ def main() -> int:
                          "(after 4 warmup cycles)")
     ap.add_argument("--pipeline-rate", type=float, default=3.0,
                     help="Poisson arrival rate for --pipeline, jobs/cycle")
+    ap.add_argument("--fanout", nargs="?", const=10000, default=None,
+                    type=int,
+                    help="run the watch fan-out bench alone at N watchers "
+                         "(default 10000) and print its summary tail")
+    ap.add_argument("--no-fanout", action="store_true",
+                    help="skip the standing 10k-watcher fan-out column in "
+                         "the all-configs summary tail")
+    ap.add_argument("--no-front-door", action="store_true",
+                    help="skip the front_door_storm submissions/sec "
+                         "headline in the all-configs summary tail")
     ap.add_argument("--no-storm", action="store_true",
                     help="skip the cfg5_storm sustained sessions/sec + p99 "
                          "task-wait headline (runs only in all-configs mode)")
@@ -979,6 +1139,21 @@ def main() -> int:
     ap.add_argument("--storm-duration", type=float, default=60.0,
                     help="cfg5_storm simulated horizon, seconds")
     args = ap.parse_args()
+
+    if args.fanout is not None:
+        # jax-free path: the fan-out bench exercises only the store/
+        # journal/flow-control layer, so it runs (and exits) before any
+        # device machinery loads
+        result = run_fanout_bench(watchers=args.fanout)
+        print(json.dumps({
+            "metric": "watch fan-out p99 delivery latency @ %d watchers"
+                      % args.fanout,
+            "value": result["fanout_p99_ms"],
+            "unit": "ms",
+        }), flush=True)
+        print(json.dumps({"summary": {"watch_fanout": result}},
+                         separators=(",", ":")), flush=True)
+        return 0
 
     mesh_counts = None
     if args.mesh is not None and args.mesh != "all":
@@ -1210,6 +1385,22 @@ def main() -> int:
                 args.storm_scale, duration=args.storm_duration)
         except Exception as e:
             print(f"[bench] storm headline failed: {e}", file=sys.stderr)
+    # the standing front-door columns (ROADMAP item 3): 10k-watcher
+    # fan-out p50/p99 delivery latency + bounded per-watcher memory, and
+    # the storm's offered-vs-admitted submissions/sec — tracked
+    # trajectory numbers like sessions/sec
+    if (not args.no_fanout and args.scenario is None and len(cfgs) > 1):
+        try:
+            summary["watch_fanout"] = run_fanout_bench()
+        except Exception as e:
+            print(f"[bench] fan-out bench failed: {e}", file=sys.stderr)
+    if (not args.no_front_door and args.scenario is None
+            and args.backend in ("tpu", "both", "auto") and len(cfgs) > 1):
+        try:
+            summary["front_door_storm"] = _front_door_headline()
+        except Exception as e:
+            print(f"[bench] front-door headline failed: {e}",
+                  file=sys.stderr)
     # the standing mesh-scaling curve (ROADMAP item 3): cfg7 at 1/2/4/8
     # devices in every all-configs run, so mesh efficiency is a tracked
     # trajectory number like sessions/sec

@@ -49,3 +49,20 @@ class GoodCache:
         # a REAL finding silenced only by the justified suppression —
         # proves the disable comment is what silences the rule
         self.nodes.pop(uid, None)  # vclint: disable=VT007 - corpus fixture: exercises the suppression path
+
+
+class GoodFanout:
+    """PR 12 front-door scope: every watcher-map mutation bumps
+    stats_gen (the memoized watch_stats() invalidation channel)."""
+
+    def __init__(self):
+        self.watchers = {}
+        self.stats_gen = 0
+
+    def register(self, wid):
+        self.watchers[wid] = object()
+        self.stats_gen += 1
+
+    def unregister(self, wid):
+        self.watchers.pop(wid, None)
+        self.stats_gen += 1

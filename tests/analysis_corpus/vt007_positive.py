@@ -29,3 +29,19 @@ class MiniCache:
         # a neutral() bless with no reason is itself a finding — the
         # grammar requires the WHY, exactly like VT000 for suppressions
         self.jobs.pop(uid, None)  # vclint: neutral()  # vclint-expect: VT007
+
+
+class MiniFanout:
+    """PR 12 front-door scope: the watcher map's stats snapshot is
+    memoized on stats_gen — a mutation that skips the bump serves stale
+    lag/demotion accounting forever."""
+
+    def __init__(self):
+        self.watchers = {}
+        self.stats_gen = 0
+
+    def register_unmarked(self, wid):
+        self.watchers[wid] = object()  # vclint-expect: VT007
+
+    def drop_unmarked(self, wid):
+        del self.watchers[wid]  # vclint-expect: VT007

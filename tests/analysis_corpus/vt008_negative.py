@@ -39,3 +39,33 @@ class GoodLane:
         # a REAL inferred-guard violation silenced only by the justified
         # suppression
         self.counters[uid] = 4  # vclint: disable=VT008 - corpus fixture: exercises the suppression path
+
+
+class GoodJournal:
+    """PR 12 front-door scope: the sanctioned send shapes."""
+
+    def __init__(self):
+        import threading
+
+        self.cond = threading.Condition()
+        self.events = []
+
+    def snapshot_then_send(self, req):
+        # snapshot under the journal lock, send AFTER it
+        with self.cond:
+            batch = tuple(self.events)
+        return self._push(batch, req)
+
+    def _push(self, batch, req):
+        return urlopen(req)
+
+    def list(self, req):
+        # a method shadowing a builtin name that happens to send
+        return urlopen(req)
+
+    def drain_under_lock(self):
+        # traversal deliberately does NOT resolve builtin-shadow names
+        # ("list", "get", ...): program-wide they alias dict/built-in
+        # calls far more often than real send paths
+        with self.cond:
+            return self.list(None)

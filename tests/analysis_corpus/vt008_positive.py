@@ -31,3 +31,26 @@ class RacyLane:
         # the device sink is one call away — only the whole-program
         # closure walk sees it (VT003's lexical check cannot)
         return solve_rounds_packed(spec)
+
+
+class LeakyJournal:
+    """PR 12 front-door scope: blocking network sends under the journal
+    lock serialize every watcher behind one slow peer."""
+
+    def __init__(self):
+        import threading
+
+        self.cond = threading.Condition()
+        self.events = []
+
+    def broadcast_under_lock(self, req):
+        with self.cond:
+            return urlopen(req)  # vclint-expect: VT008
+
+    def notify_under_lock(self, req):
+        with self.cond:
+            return self._push(req)  # vclint-expect: VT008
+
+    def _push(self, req):
+        # the send is one call away — the closure walk sees it
+        return urlopen(req)

@@ -286,6 +286,83 @@ class TestSmokeScenarios:
         assert a["pipeline"] == b["pipeline"]
         assert a["binds"] == b["binds"]
 
+    def test_front_door_storm_sheds_with_retry_and_converges(self):
+        """front_door_storm smoke (reduced scale): a heavy-tailed
+        submission storm against the intake gate plus a flow-controlled
+        watcher fleet with a deliberately slow tail, through reset
+        storms, mirror 5xx, and one leader kill. The auditor must hold
+        the shed-with-retry and fan-out-convergence contracts (plus the
+        shed/coalesce budgets and every standing rule) with zero
+        violations — while the scheduler keeps committing sessions."""
+        cfg = scale_scenario(load_scenario("front_door_storm"), 0.5)
+        s = SimCluster(cfg, seed=7).run()
+        assert s["audit"]["violations"] == 0, s["audit"]
+        fd = s["front_door"]
+        assert fd is not None
+        # the storm actually shed — and every shed scheduled a retry,
+        # with a real share re-admitted inside the horizon
+        assert fd["shed_submissions"] > 50, fd
+        assert fd["shed_submissions"] == fd["shed_retries_scheduled"]
+        assert fd["shed_readmitted"] > 0, fd
+        # priority-aware shedding: the batch class sheds at a strictly
+        # higher rate than the interactive/express-eligible class
+        intake = fd["intake"]
+        batch_attempts = intake["admitted_batch"] + intake["shed_batch"]
+        inter_attempts = (intake["admitted_interactive"]
+                          + intake["shed_interactive"])
+        assert batch_attempts > 0 and inter_attempts > 0
+        assert (intake["shed_batch"] / batch_attempts
+                > intake["shed_interactive"] / inter_attempts), intake
+        # the slow tail was demoted to snapshot-resync AND converged
+        # (auditor-verified: front_door_watchers ran with 0 violations)
+        watch = fd["watch"]
+        assert watch["counters"]["demotions"] >= 5, watch["counters"]
+        assert watch["counters"]["promotions"] >= 5, watch["counters"]
+        assert fd["fleet"]["resets"] >= 1
+        assert fd["fleet"]["synthesized_deletes"] >= 1
+        # bounded retention held (the journal-pinning fix)
+        journal = watch["journal"]
+        assert journal["peak_occupancy"] <= min(
+            max(watch["demote_lag"], journal["cap"]),
+            journal["hard_cap"])
+        # the scheduler kept committing sessions through the storm (no
+        # skips beyond the PR 8 staleness budget — sessions track the
+        # horizon/period exactly)
+        horizon = s["sim_duration_s"]
+        period = cfg["scheduler"]["period_s"]
+        assert s["sessions"] >= int(horizon / period) - 2, s["sessions"]
+        assert s["binds"] > 100
+        # the leader kill landed and the takeover met the HA contract
+        assert sum(s["ha"]["leader_kills"].values()) >= 1
+        # shed/coalesce rates are budget-metered in the summary
+        rates = s["fallbacks"]
+        assert 0.0 < rates["admission_shed_rate"] <= 0.75
+        assert rates["watch_events_coalesced"] >= 0
+
+    def test_front_door_storm_same_seed_identical_hash(self):
+        cfg = scale_scenario(load_scenario("front_door_storm"), 0.25)
+        a = SimCluster(cfg, seed=11).run(duration=60.0)
+        b = SimCluster(cfg, seed=11).run(duration=60.0)
+        assert a["event_log_hash"] == b["event_log_hash"]
+        assert a["front_door"]["intake"] == b["front_door"]["intake"]
+        assert a["front_door"]["watch"]["counters"] \
+            == b["front_door"]["watch"]["counters"]
+        assert a["binds"] == b["binds"]
+
+    def test_front_door_shed_budget_fails_when_tightened(self):
+        """The budget gate is non-vacuous: tightening the shed budget to
+        an impossible bound must FAIL the audit (the same proven-to-fire
+        idiom as PR 11's fallback budgets)."""
+        def mutate(cfg):
+            cfg["audit"]["budgets"]["admission_shed_rate"] = {
+                "max": 0.001, "min_n": 10}
+
+        cfg = scale_scenario(load_scenario("front_door_storm"), 0.5)
+        mutate(cfg)
+        s = SimCluster(cfg, seed=7).run(duration=60.0)
+        assert s["audit"]["violations"] > 0
+        assert "fallback_budget" in s["audit"]["kinds"], s["audit"]
+
 
 # ---------------------------------------------------------------------------
 # 3. auditor self-test (seeded bug fixtures)
@@ -373,6 +450,17 @@ class TestCfg5Scale:
         assert sum(s["ha"]["leader_kills"].values()) >= 3
         assert s["ha"]["fence"]["rejected"] \
             == s["ha"]["fence"]["observed_by_effectors"]
+
+    @pytest.mark.slow
+    def test_full_scale_front_door_storm(self):
+        cfg = copy.deepcopy(load_scenario("front_door_storm"))
+        s = SimCluster(cfg, seed=7, repro_dir=None).run()
+        assert s["audit"]["violations"] == 0, s["audit"]
+        fd = s["front_door"]
+        assert fd["shed_submissions"] > 100
+        assert fd["shed_submissions"] == fd["shed_retries_scheduled"]
+        assert fd["watch"]["counters"]["demotions"] > 50
+        assert sum(s["ha"]["leader_kills"].values()) >= 1
 
     @pytest.mark.slow
     def test_chaos_soak_two_hours(self):

@@ -118,6 +118,13 @@ class TestFramework:
         assert vt3.applies_to("volcano_tpu/controllers/job/controller.py")
         assert vt3.applies_to("volcano_tpu/scheduler/cache/cache.py")
         assert not vt3.applies_to("volcano_tpu/ops/solver.py")
+        # the front-door layer (PR 12) sits inside the mutation->
+        # invalidation and whole-program lock scopes
+        for rid in ("VT007", "VT008"):
+            for path in ("volcano_tpu/store/flowcontrol.py",
+                         "volcano_tpu/store/gateway.py",
+                         "volcano_tpu/admission/intake.py"):
+                assert get_rule(rid).applies_to(path), (rid, path)
 
     def test_syntax_error_reported_not_raised(self):
         findings = analyze_source("def broken(:\n", "broken.py",

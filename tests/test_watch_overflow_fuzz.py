@@ -267,6 +267,213 @@ class TestLocalMirrorFuzz:
         assert mirror.synthesized_deletes == 20
 
 
+def _replay(events, state: dict) -> None:
+    """Apply a delivered batch to a level-triggered mirror state."""
+    for entry in events:
+        etype = entry.get("type")
+        if etype in ("ADDED", "MODIFIED"):
+            from volcano_tpu.api import codec
+
+            obj = codec.from_envelope(entry["object"])
+            state[object_key(obj)] = obj.metadata.resource_version
+        elif etype == "DELETED":
+            from volcano_tpu.api import codec
+
+            obj = codec.from_envelope(entry["old"])
+            state.pop(object_key(obj), None)
+
+
+class TestEventCompactor:
+    """compact_events — the general delivery-side coalescer: a compacted
+    batch must drive any level-triggered consumer to the IDENTICAL final
+    state as the raw batch, for a strictly smaller decode bill."""
+
+    @pytest.mark.parametrize("seed", [21, 22, 23, 24])
+    def test_compacted_replay_matches_raw_replay(self, seed):
+        from volcano_tpu.store.flowcontrol import compact_events
+
+        rng = random.Random(seed)
+        store = Store()
+        journal = _WatchJournal(store, "Pod", cap=100000)
+        live: dict = {}
+        idx = 0
+        for _ in range(400):
+            idx = _churn(store, rng, live, idx)
+        events, _, reset = journal.poll(0, 0.0)
+        assert not reset
+        compacted, coalesced = compact_events(events)
+        assert coalesced > 0, "fuzz never exercised compaction"
+        assert len(compacted) == len(events) - coalesced
+        raw_state: dict = {}
+        compact_state: dict = {}
+        _replay(events, raw_state)
+        _replay(compacted, compact_state)
+        assert compact_state == raw_state
+        # and the final state is the store's truth
+        truth = {object_key(p): p.metadata.resource_version
+                 for p in store.list("Pod")}
+        assert compact_state == truth
+
+    def test_delete_recreate_never_merges(self):
+        from volcano_tpu.store.flowcontrol import compact_events
+
+        store = Store()
+        journal = _WatchJournal(store, "Pod", cap=100000)
+        pod = _make_pod(0)
+        store.create(pod)
+        store.delete("Pod", "fuzz", pod.metadata.name)
+        pod2 = _make_pod(0)
+        store.create(pod2)
+        events, _, _ = journal.poll(0, 0.0)
+        compacted, coalesced = compact_events(events)
+        # ADDED+DELETED annihilate; the re-create survives as its own
+        # ADDED (never merged across the delete boundary — the objects
+        # carry different identities)
+        kinds = [e["type"] for e in compacted]
+        assert kinds == ["ADDED"], kinds
+        from volcano_tpu.api import codec
+
+        assert codec.from_envelope(
+            compacted[0]["object"]).metadata.uid == pod2.metadata.uid
+
+
+class TestFanoutDemotion:
+    """Slow-watcher demotion -> snapshot-resync on the gateway-local
+    path: the laggard is evicted with a resumable cursor (never buffered
+    for), resyncs through the reset/re-list protocol, and converges —
+    while the shared journal's occupancy stays bounded by
+    min(demote_lag, hard_cap) with the stalled watcher unable to pin it
+    past the cap after demotion."""
+
+    def _fanout_mirrors(self, store, cap=16, demote_lag=24, n=3):
+        from volcano_tpu.sim.mirror import JournalMirror
+        from volcano_tpu.store.flowcontrol import WatchFanout
+
+        journal = _WatchJournal(store, "Pod", cap=cap)
+        fanout = WatchFanout(journal, demote_lag=demote_lag,
+                             pin_factor=4)
+        mirrors = [JournalMirror(store, "Pod", journal=journal,
+                                 fanout=fanout,
+                                 watcher_id=f"w{i}",
+                                 watcher_class="batch")
+                   for i in range(n)]
+        return journal, fanout, mirrors
+
+    @pytest.mark.parametrize("seed", [31, 32, 33])
+    def test_slow_watcher_demotes_then_converges(self, seed):
+        rng = random.Random(seed)
+        store = Store()
+        journal, fanout, mirrors = self._fanout_mirrors(store)
+        fast, slow = mirrors[0], mirrors[1]
+        live: dict = {}
+        idx = 0
+        for _ in range(4):
+            idx = _churn(store, rng, live, idx)
+        for m in mirrors:
+            m.drain()  # register every cursor before the storm
+        for _ in range(30):
+            for _ in range(rng.randrange(4, 20)):
+                idx = _churn(store, rng, live, idx)
+            fast.drain()
+            # the slow watcher drains rarely: it must fall past
+            # demote_lag and be demoted instead of pinning the ring
+            if rng.random() < 0.1:
+                slow.drain()
+        assert fanout.counters["demotions"] >= 1, fanout.counters
+        # demotion freed the ring: occupancy bounded by the cap once the
+        # laggard is demoted (one more append settles the trim)
+        idx = _churn(store, rng, live, idx)
+        assert len(journal.events) <= max(
+            journal.cap, fanout.demote_lag), journal.stats()
+        # both converge — the demoted one via reset/re-list resync
+        for m in mirrors:
+            m.catch_up()
+            assert m.diff_vs_store() == {
+                "phantom": [], "missing": [], "stale": []}
+        assert slow.resets >= 1
+        assert fanout.counters["promotions"] >= 1
+
+    def test_stalled_watcher_cannot_pin_past_cap(self):
+        """The journal-accounting fix: a live laggard may hold retention
+        open (bounded), but once it lags past demote_lag it is demoted
+        AT APPEND TIME — even if it never polls again — and the ring
+        falls back to its soft cap."""
+        store = Store()
+        journal, fanout, mirrors = self._fanout_mirrors(
+            store, cap=16, demote_lag=24)
+        stalled = mirrors[0]
+        for i in range(8):
+            store.create(_make_pod(i))
+        stalled.drain()  # registers the cursor, then stalls forever
+        peak = 0
+        for i in range(8, 80):
+            store.create(_make_pod(i))
+            peak = max(peak, len(journal.events))
+        # while live, retention stretched past the soft cap...
+        assert peak > journal.cap
+        # ...but never past min(demote_lag, hard_cap)
+        assert peak <= min(fanout.demote_lag, fanout.hard_cap), peak
+        # and after the append-time demotion the ring is back at cap
+        assert len(journal.events) <= journal.cap
+        assert fanout.demotions_by_reason.get("append_lag", 0) >= 1
+        # the stalled watcher still converges when it finally wakes
+        stalled.catch_up()
+        assert stalled.diff_vs_store() == {
+            "phantom": [], "missing": [], "stale": []}
+
+    def test_shared_batch_is_one_object(self):
+        """The fan-out fast path: watchers at the same cursor receive
+        the SAME immutable batch — O(events + watchers), not
+        O(events x watchers) copies."""
+        store = Store()
+        journal, fanout, _ = self._fanout_mirrors(store, cap=64)
+        for i in range(10):
+            store.create(_make_pod(i))
+        a, _, _ = fanout.poll_for("wa", 0, 0.0)
+        b, _, _ = fanout.poll_for("wb", 0, 0.0)
+        assert a is b, "same-cursor watchers must share one batch"
+
+    def test_aggressive_coalesce_rung_compacts_small_batches(self):
+        """watch_coalesce_aggressive: with the ladder's hold armed, even
+        tiny batches are compacted (threshold drops to 2)."""
+        from volcano_tpu.scheduler.degrade import DegradeLadder
+        from volcano_tpu.sim.mirror import JournalMirror
+        from volcano_tpu.store.flowcontrol import WatchFanout
+
+        ladder = DegradeLadder()
+        store = Store()
+        journal = _WatchJournal(store, "Pod", cap=64)
+        fanout = WatchFanout(journal, demote_lag=128, coalesce_min=64,
+                             ladder=ladder)
+        pod = _make_pod(0)
+        store.create(pod)
+        import copy
+
+        # a pacer watcher serves the head after every update, so the
+        # MODIFIED chain cannot write-side squash — the catch-up batch
+        # genuinely holds one entry per update
+        pacer = JournalMirror(store, "Pod", journal=journal,
+                              fanout=fanout, watcher_id="pacer")
+        pacer.catch_up()
+        since = pacer.since
+        for i in range(3):
+            upd = copy.deepcopy(store.get("Pod", "fuzz",
+                                          pod.metadata.name))
+            upd.metadata.annotations["i"] = str(i)
+            store.update(upd)
+            pacer.catch_up()
+        baseline = fanout.counters["coalesced"]
+        events, _, _ = fanout.poll_for("cold", since, 0.0)
+        assert len(events) == 3, [e["type"] for e in events]
+        assert fanout.counters["coalesced"] == baseline, \
+            "small batch must NOT compact while healthy"
+        ladder.note_watch_lag(100, 128)  # arm the rung
+        events, _, _ = fanout.poll_for("cold2", since, 0.0)
+        assert len(events) == 1, [e["type"] for e in events]
+        assert fanout.counters["coalesced"] > baseline, \
+            "armed rung must compact even small batches"
+
+
 class TestRemoteWatchFuzz:
     def test_remote_consumer_lags_past_tiny_ring(self):
         store = Store()
@@ -315,6 +522,68 @@ class TestRemoteWatchFuzz:
                 f"remote mirror did not converge: "
                 f"{len(set(snapshot) - set(truth))} phantom, "
                 f"{len(set(truth) - set(snapshot))} missing")
+            remote.stop_watches()
+        finally:
+            gateway.stop()
+
+    def test_remote_watcher_demoted_to_resync_converges(self):
+        """The RemoteStore half of the demotion contract: a flow-
+        controlled remote watcher (watcher_id on the wire) that lags
+        past demote_lag is demoted server-side; the client sees the
+        standard reset, re-lists, and converges — no phantoms, no lost
+        deletes — while the gateway's watch_stats records the demotion."""
+        store = Store()
+        gateway = ApiGateway(store, journal_cap=16,
+                             watch_demote_lag=24).start()
+        try:
+            remote = RemoteStore(f"127.0.0.1:{gateway.port}")
+            known: dict = {}
+            lock = threading.Lock()
+
+            def on_added(obj):
+                with lock:
+                    known[object_key(obj)] = obj.metadata.resource_version
+
+            def on_updated(old, new):
+                with lock:
+                    known[object_key(new)] = new.metadata.resource_version
+
+            def on_deleted(obj):
+                with lock:
+                    known.pop(object_key(obj), None)
+
+            remote.watch("Pod", WatchHandler(
+                added=on_added, updated=on_updated, deleted=on_deleted),
+                poll_timeout=0.2, watcher_id="remote-consumer",
+                watcher_class="batch")
+
+            rng = random.Random(7)
+            live: dict = {}
+            idx = 0
+            for _ in range(5):
+                # bursts far past cap AND demote_lag between long-poll
+                # rounds: the server must demote rather than stream an
+                # unbounded catch-up
+                for _ in range(80):
+                    idx = _churn(store, rng, live, idx)
+                time.sleep(0.05)
+
+            truth = {object_key(p): p.metadata.resource_version
+                     for p in store.list("Pod")}
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                with lock:
+                    snapshot = dict(known)
+                if snapshot == truth:
+                    break
+                time.sleep(0.1)
+            assert snapshot == truth, (
+                f"demoted remote watcher did not converge: "
+                f"{len(set(snapshot) - set(truth))} phantom, "
+                f"{len(set(truth) - set(snapshot))} missing")
+            stats = gateway.watch_stats()["Pod"]
+            assert stats["counters"]["registered"] >= 1, stats
+            assert remote.watch_stats()["resets"] >= 1
             remote.stop_watches()
         finally:
             gateway.stop()

@@ -7,5 +7,11 @@ from volcano_tpu.admission.admission import (
     validate_job,
     validate_pod,
 )
+from volcano_tpu.admission.intake import (
+    IntakeGate,
+    classify_job,
+    install_intake,
+)
 
-__all__ = ["install", "mutate_job", "validate_job", "validate_pod"]
+__all__ = ["install", "mutate_job", "validate_job", "validate_pod",
+           "IntakeGate", "classify_job", "install_intake"]

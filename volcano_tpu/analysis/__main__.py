@@ -165,6 +165,12 @@ def _explain(rule_id: str, paths) -> int:
         print(f"sealed attrs: {sorted(a for a in sealed if rule._CHANNEL_ATTR.search(a))}")
         for ch in sorted(model.channel_sites):
             for path, line, attr in model.channel_sites[ch]:
+                if not rule.applies_to(path):
+                    # channels outside the fingerprint scope (e.g. the
+                    # fan-out's stats_gen memoization channel) are not
+                    # speculation-seal candidates — the rule never
+                    # checks them, so the report must not either
+                    continue
                 state = "sealed" if attr in sealed else "UNSEALED"
                 print(f"{path}:{line} {attr:20s} channel={ch:15s} {state}")
         return 0

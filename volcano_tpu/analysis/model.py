@@ -78,6 +78,10 @@ EFFECT_ATTR_BUMPS = {
     "dirty_epoch": "dirty_epoch",
     "generation": "generation",
     "commit_epoch": "commit_epoch",
+    # front-door fan-out (store/flowcontrol.py): watch_stats() memoizes
+    # on stats_gen, so every watcher-map mutation must bump it or the
+    # aggregate snapshot goes silently stale
+    "stats_gen": "frontdoor_stats",
 }
 
 # snapshot-bearing mutating method calls (receiver-attr name)
@@ -89,10 +93,12 @@ MUTATING_CALLS = {
 }
 
 # snapshot-bearing containers: subscript writes / mutating dict calls on
-# an attribute chain ending in one of these
+# an attribute chain ending in one of these. "watchers" is the fan-out
+# layer's per-watcher map (store/flowcontrol.py) — its stats snapshot is
+# memoized on stats_gen, so unmarked mutations stale it.
 STATE_CONTAINERS = {
     "jobs", "nodes", "queues", "priority_classes",
-    "namespace_collection", "tasks",
+    "namespace_collection", "tasks", "watchers",
 }
 _CONTAINER_MUTATORS = {"pop", "setdefault", "clear", "update"}
 
@@ -113,6 +119,12 @@ DEVICE_DISPATCH = {
     "solve_express", "solve_preempt", "solve_reclaim", "solve_backfill",
     "solve_fused_chain", "start_fetch", "device_put", "block_until_ready",
 }
+
+# blocking network sends for the VT008 front-door scope: under the
+# journal lock (or any watch-path lock), a socket/HTTP send would stall
+# every watcher behind one slow peer — snapshot under the lock, send
+# after it
+BLOCKING_SENDS = {"sendall", "urlopen", "serve_forever"}
 
 _NEUTRAL_RE = re.compile(r"vclint:\s*neutral\(([^)]*)\)")
 

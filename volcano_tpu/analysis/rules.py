@@ -1076,7 +1076,12 @@ class MutationInvalidation(Rule):
     id = "VT007"
     title = "snapshot-bearing mutation unreachable from any invalidation"
     patterns = ("*/scheduler/cache/cache.py", "*/express/*.py",
-                "*/pipeline/*.py", "*/sim/mirror.py")
+                "*/pipeline/*.py", "*/sim/mirror.py",
+                # front-door flow control (PR 12): the fan-out's watcher
+                # map memoizes its stats on stats_gen — a mutation that
+                # skips the bump serves stale lag/demotion accounting
+                "*/store/flowcontrol.py", "*/store/gateway.py",
+                "*/admission/intake.py")
 
     def check(self, tree, src, path):
         findings: List[Finding] = []
@@ -1138,7 +1143,13 @@ class WholeProgramLocks(Rule):
     title = "whole-program lock-discipline violation"
     patterns = ("*/scheduler/cache/*.py", "*/express/*.py",
                 "*/pipeline/*.py", "*/scheduler/ha.py",
-                "*/scheduler/degrade.py", "*/sim/mirror.py")
+                "*/scheduler/degrade.py", "*/sim/mirror.py",
+                # front-door scope (PR 12): journal/fan-out/intake state
+                # is lock-inferred too, and the journal lock additionally
+                # must never reach a BLOCKING network send (one slow
+                # socket would stall every watcher)
+                "*/store/flowcontrol.py", "*/store/gateway.py",
+                "*/admission/intake.py")
 
     _CLOSURE_DEPTH = 5
 
@@ -1173,8 +1184,21 @@ class WholeProgramLocks(Rule):
                         f"so this write races them; take the lock or "
                         f"move the field out of the guarded set"))
 
+    # the blocking-send CLOSURE check runs only where the journal-lock
+    # contract lives: traversal through generic names ("list", "get")
+    # shadowing builtins reaches RemoteStore verbs spuriously elsewhere.
+    # The corpus fixtures are in scope so the path stays test-pinned.
+    _SEND_SCOPE = ("store/flowcontrol.py", "store/gateway.py",
+                   "admission/intake.py",
+                   "analysis_corpus/vt008_positive.py",
+                   "analysis_corpus/vt008_negative.py")
+    _BUILTIN_SHADOWS = frozenset({
+        "list", "get", "set", "dict", "items", "values", "keys", "pop",
+        "update", "copy", "type", "next", "iter", "filter", "map"})
+
     def _check_dispatch_closure(self, model, path, findings):
         norm = path.replace("\\", "/")
+        include_sends = norm.endswith(self._SEND_SCOPE)
         for fi in model.funcs:
             fp = fi.path.replace("\\", "/")
             if fp != norm and not norm.endswith(fp):
@@ -1187,18 +1211,36 @@ class WholeProgramLocks(Rule):
                     name = self._dispatch_name(call)
                     if name is None:
                         continue
+                    if name in wpm.BLOCKING_SENDS:
+                        # direct case is OURS (VT003 does not scope the
+                        # store layer): a blocking network send under a
+                        # watch/journal lock serializes every watcher
+                        # behind one slow peer
+                        findings.append(Finding(
+                            self.id, path, call.lineno, call.col_offset,
+                            f"blocking send {name}() under {lock_desc} "
+                            f"— one slow peer would stall every watcher "
+                            f"sharing the lock; snapshot under the "
+                            f"lock, send after it"))
+                        continue
                     if name in wpm.DEVICE_DISPATCH:
                         continue  # lexical case: VT003(d) owns it
-                    chain = self._closure_dispatch(model, fi, name)
+                    chain = self._closure_dispatch(
+                        model, fi, name, include_sends=include_sends)
                     if chain and call.lineno not in direct_lines:
+                        sink = chain[-1]
+                        what = ("a blocking send"
+                                if sink in wpm.BLOCKING_SENDS
+                                else "device work")
                         findings.append(Finding(
                             self.id, path, call.lineno, call.col_offset,
                             f"call {name}() under {lock_desc} reaches "
-                            f"device work through "
-                            f"{' -> '.join(chain)} — a dispatch (and "
-                            f"any implicit compile) must never run with "
-                            f"a lock held; snapshot under the lock, "
-                            f"dispatch after it"))
+                            f"{what} through "
+                            f"{' -> '.join(chain)} — neither a dispatch "
+                            f"(with any implicit compile) nor a blocking "
+                            f"send may ever run with a lock held; "
+                            f"snapshot under the lock, dispatch/send "
+                            f"after it"))
         return findings
 
     @staticmethod
@@ -1209,10 +1251,16 @@ class WholeProgramLocks(Rule):
             return call.func.id
         return None
 
-    def _closure_dispatch(self, model, from_fn, name):
+    def _closure_dispatch(self, model, from_fn, name,
+                          include_sends: bool = False):
         """['refresh', 'stage', 'device_put'] when the named callee's
-        closure reaches a device sink, else None."""
+        closure reaches a device (or, in the front-door scope, a
+        blocking-send) sink, else None."""
+        sinks = wpm.DEVICE_DISPATCH | (
+            wpm.BLOCKING_SENDS if include_sends else frozenset())
         seen = set()
+        if include_sends and name in self._BUILTIN_SHADOWS:
+            return None
         frontier = [(t, [name]) for t in model.resolve(name, from_fn)]
         for _ in range(self._CLOSURE_DEPTH):
             nxt = []
@@ -1220,10 +1268,12 @@ class WholeProgramLocks(Rule):
                 if fn.qualname in seen:
                     continue
                 seen.add(fn.qualname)
-                hit = sorted(fn.callees & wpm.DEVICE_DISPATCH)
+                hit = sorted(fn.callees & sinks)
                 if hit:
                     return chain + [hit[0]]
                 for callee in sorted(fn.callees):
+                    if include_sends and callee in self._BUILTIN_SHADOWS:
+                        continue
                     for target in model.resolve(callee, fn):
                         nxt.append((target, chain + [callee]))
             frontier = nxt

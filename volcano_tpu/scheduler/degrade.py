@@ -25,6 +25,21 @@ failing dependency; see docs/DESIGN.md §15):
 - ``express_disabled``     — the express lane's breaker is open (repeated
   batch errors) or the lane was parked by lease loss: arrivals fall
   through to full sessions;
+- ``watch_coalesce_aggressive`` — watch fan-out lag is climbing (a
+  watcher crossed half its demotion budget): the fan-out layer
+  (store/flowcontrol.py) compacts EVERY delivery batch instead of only
+  large catch-ups, trading event granularity for drain rate before any
+  watcher has to be demoted;
+- ``admission_shed``       — the intake gate (admission/intake.py) is
+  actively shedding submissions: rejected-with-retry, batch before
+  interactive; the gauge holds for ``shed_hold_s`` past the last shed so
+  scrapers see bursts shorter than their interval;
+- ``snapshot_resync_only`` — the front-door breaker is open (a demotion
+  storm — watchers falling off faster than they resync): deep laggards
+  stop receiving incremental catch-up streams entirely and are answered
+  with the reset/re-list contract immediately, keeping the journal and
+  the delivery path bounded while the herd recovers; a successful
+  resync (promotion) is the half-open probe's success;
 - ``session_skip``         — the remote-store breaker is open: skip
   sessions rather than schedule against an unreachable truth, with a
   BOUNDED staleness budget (after ``max_session_skips`` consecutive
@@ -50,8 +65,9 @@ from typing import Dict, Optional
 from volcano_tpu.scheduler import metrics
 from volcano_tpu.utils import clock
 
-RUNGS = ("per_action_fallback", "pipeline_disabled", "serial_host_solve",
-         "express_disabled", "session_skip")
+RUNGS = ("per_action_fallback", "watch_coalesce_aggressive",
+         "pipeline_disabled", "serial_host_solve", "express_disabled",
+         "admission_shed", "snapshot_resync_only", "session_skip")
 
 
 class Backoff:
@@ -164,6 +180,9 @@ class DegradeLadder:
                  kernel_threshold: int = 3, kernel_cooldown_s: float = 60.0,
                  express_threshold: int = 3, express_cooldown_s: float = 30.0,
                  pipeline_threshold: int = 3, pipeline_cooldown_s: float = 30.0,
+                 frontdoor_threshold: int = 5,
+                 frontdoor_cooldown_s: float = 10.0,
+                 coalesce_hold_s: float = 10.0, shed_hold_s: float = 5.0,
                  max_session_skips: int = 5):
         self.store = CircuitBreaker("store", store_threshold,
                                     store_cooldown_s)
@@ -173,10 +192,19 @@ class DegradeLadder:
                                       express_cooldown_s)
         self.pipeline = CircuitBreaker("pipeline", pipeline_threshold,
                                        pipeline_cooldown_s)
+        # front-door breaker: failure = a watcher demotion, success = a
+        # completed resync (promotion). Open = snapshot_resync_only.
+        self.frontdoor = CircuitBreaker("frontdoor", frontdoor_threshold,
+                                        frontdoor_cooldown_s)
+        self.coalesce_hold_s = float(coalesce_hold_s)
+        self.shed_hold_s = float(shed_hold_s)
+        self._coalesce_until = 0.0
+        self._shed_until = 0.0
         self.max_session_skips = int(max_session_skips)
         self._skips = 0
         self.counters = {"sessions_skipped": 0, "forced_sessions": 0,
-                         "per_action_fallbacks": 0}
+                         "per_action_fallbacks": 0, "watch_demotions": 0,
+                         "watch_promotions": 0, "admission_sheds": 0}
 
     # -- dependency reports (each publishes its rung transition) -----------
 
@@ -218,6 +246,37 @@ class DegradeLadder:
         self.pipeline.record_success()
         self._publish()
 
+    # -- front-door signals (watch fan-out + admission intake) --------------
+
+    def note_watch_lag(self, lag: int, demote_lag: int) -> None:
+        """A watcher's poll observed ``lag`` pending events against the
+        fan-out's ``demote_lag`` budget. Crossing HALF the budget arms
+        the watch_coalesce_aggressive rung for ``coalesce_hold_s`` —
+        compaction ramps up BEFORE anyone has to be demoted."""
+        if demote_lag > 0 and 2 * lag >= demote_lag:
+            self._coalesce_until = clock.now() + self.coalesce_hold_s
+            self._publish()
+
+    def note_watch_demotion(self) -> None:
+        self.counters["watch_demotions"] += 1
+        self.frontdoor.record_failure()
+        self._publish()
+
+    def note_watch_promoted(self) -> None:
+        """A demoted watcher completed its snapshot resync — the
+        front-door breaker's success signal (and half-open probe)."""
+        self.counters["watch_promotions"] += 1
+        self.frontdoor.record_success()
+        self._publish()
+
+    def note_admission_shed(self) -> None:
+        self.counters["admission_sheds"] += 1
+        self._shed_until = clock.now() + self.shed_hold_s
+        self._publish()
+
+    def note_admission_ok(self) -> None:
+        self._publish()
+
     # -- the gates callers consult ------------------------------------------
 
     def force_serial(self) -> bool:
@@ -237,6 +296,21 @@ class DegradeLadder:
         cycle (byte-for-byte the VOLCANO_TPU_PIPELINE=0 oracle) until the
         half-open probe lets one pipelined cycle prove itself again."""
         return self.pipeline.allow()
+
+    def watch_coalesce_aggressive(self) -> bool:
+        """True while delivery batches should be compacted regardless of
+        size: the lag signal armed the hold window, or the front-door
+        breaker is already open (resync-only implies coalesce-hard)."""
+        return clock.now() < self._coalesce_until \
+            or self.frontdoor.state != CircuitBreaker.CLOSED
+
+    def watch_resync_only(self) -> bool:
+        """True while deep laggards must be answered with an immediate
+        reset/re-list instead of an incremental catch-up stream. allow()
+        doubles as the half-open probe: after the cooldown exactly one
+        laggard gets an incremental attempt, and its completed resync
+        (note_watch_promoted) closes the breaker."""
+        return not self.frontdoor.allow()
 
     def should_skip_session(self) -> bool:
         """True while the store breaker is open AND the staleness budget
@@ -260,12 +334,18 @@ class DegradeLadder:
         inspection — allow() would consume a half-open probe slot."""
         if self.store.state != CircuitBreaker.CLOSED or self._skips:
             return "session_skip"
+        if self.frontdoor.state != CircuitBreaker.CLOSED:
+            return "snapshot_resync_only"
+        if clock.now() < self._shed_until:
+            return "admission_shed"
         if self.express.state != CircuitBreaker.CLOSED:
             return "express_disabled"
         if self.kernel.state != CircuitBreaker.CLOSED:
             return "serial_host_solve"
         if self.pipeline.state != CircuitBreaker.CLOSED:
             return "pipeline_disabled"
+        if clock.now() < self._coalesce_until:
+            return "watch_coalesce_aggressive"
         return ""
 
     def _publish(self) -> None:
@@ -280,6 +360,15 @@ class DegradeLadder:
         metrics.set_degraded_mode(
             "pipeline_disabled",
             self.pipeline.state != CircuitBreaker.CLOSED)
+        now = clock.now()
+        metrics.set_degraded_mode(
+            "watch_coalesce_aggressive",
+            now < self._coalesce_until
+            or self.frontdoor.state != CircuitBreaker.CLOSED)
+        metrics.set_degraded_mode("admission_shed", now < self._shed_until)
+        metrics.set_degraded_mode(
+            "snapshot_resync_only",
+            self.frontdoor.state != CircuitBreaker.CLOSED)
 
     def stats(self) -> Dict[str, object]:
         return {
@@ -287,7 +376,7 @@ class DegradeLadder:
             "counters": dict(self.counters),
             "breakers": {b.name: {"state": b.state, **b.stats}
                          for b in (self.store, self.kernel, self.express,
-                                   self.pipeline)},
+                                   self.pipeline, self.frontdoor)},
         }
 
 

@@ -175,6 +175,24 @@ class Registry:
             f"{_NAMESPACE}_device_fallbacks_total",
             "Device-path honesty fallbacks to the serial oracle, by kind",
             ("kind",))
+        # front-door overload (store/flowcontrol.py + admission/intake.py):
+        # per-class watch fan-out lag, delivery-side coalescing, and the
+        # intake gate's shed/retry-after accounting — the meters the
+        # front_door_storm auditor budgets ride on
+        self.watch_queue_depth = Gauge(
+            f"{_NAMESPACE}_watch_queue_depth",
+            "Pending watch events behind the slowest observed cursor, "
+            "per watcher class", ("watcher_class",))
+        self.watch_events_coalesced = Counter(
+            f"{_NAMESPACE}_watch_events_coalesced_total",
+            "Watch events collapsed by delivery-side batch compaction")
+        self.admission_shed = Counter(
+            f"{_NAMESPACE}_admission_shed_total",
+            "Submissions shed by the intake gate, by reason", ("reason",))
+        self.admission_retry_after = Histogram(
+            f"{_NAMESPACE}_admission_retry_after_seconds",
+            "Retry-after hints handed to shed submissions, in seconds",
+            [0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0])
         # instantaneous cluster levels (set each cycle; the sim harness and
         # the scheduler loop both publish through these)
         self.pending_pods = Gauge(
@@ -310,6 +328,22 @@ def observe_pipeline_overlap(seconds: float) -> None:
     registry().pipeline_overlap.observe(seconds)
 
 
+def set_watch_queue_depth(watcher_class: str, n: int) -> None:
+    registry().watch_queue_depth.set(n, (watcher_class,))
+
+
+def register_watch_coalesced(n: int = 1) -> None:
+    registry().watch_events_coalesced.inc(value=n)
+
+
+def register_admission_shed(reason: str, n: int = 1) -> None:
+    registry().admission_shed.inc((reason,), n)
+
+
+def observe_admission_retry_after(seconds: float) -> None:
+    registry().admission_retry_after.observe(seconds)
+
+
 # -- exposition -------------------------------------------------------------
 
 
@@ -318,7 +352,8 @@ def render() -> str:
     r = registry()
     lines: List[str] = []
     for h in (r.e2e_latency, r.plugin_latency, r.action_latency,
-              r.task_latency, r.express_latency, r.pipeline_overlap):
+              r.task_latency, r.express_latency, r.pipeline_overlap,
+              r.admission_retry_after):
         lines.append(f"# HELP {h.name} {h.help}")
         lines.append(f"# TYPE {h.name} histogram")
         for labels, (counts, total, n) in h.snapshot().items():
@@ -339,7 +374,8 @@ def render() -> str:
         r.unschedule_task_count, r.unschedule_job_count, r.job_retry_counts,
         r.express_placements, r.express_reverted, r.express_deferred,
         r.leader_transitions, r.fenced_writes_rejected,
-        r.pipeline_spec_discards,
+        r.pipeline_spec_discards, r.watch_events_coalesced,
+        r.admission_shed,
     ):
         lines.append(f"# HELP {c.name} {c.help}")
         lines.append(f"# TYPE {c.name} counter")
@@ -349,7 +385,8 @@ def render() -> str:
                 suffix = f"{{{label_str}}}" if label_str else ""
                 lines.append(f"{c.name}{suffix} {v}")
     for g in (r.pending_pods, r.queue_depth, r.sessions_run,
-              r.degraded_mode, r.pipeline_sessions_per_sec):
+              r.degraded_mode, r.pipeline_sessions_per_sec,
+              r.watch_queue_depth):
         lines.append(f"# HELP {g.name} {g.help}")
         lines.append(f"# TYPE {g.name} gauge")
         with g._lock:

@@ -61,7 +61,22 @@ the metrics registry against each other:
                           cycle bound with ZERO wholesale snapshot
                           rebuilds and ZERO kernel compiles (the warm-
                           standby contract), and drained every express
-                          token the deposed term left behind.
+                          token the deposed term left behind;
+- ``front_door_shed``   — (front-door scenarios) every submission the
+                          intake gate shed scheduled a retry (rejected-
+                          with-retry, never dropped silently) and the
+                          gate's shed ledger matches the submitter's
+                          observations exactly;
+- ``front_door_watchers`` — (front-door scenarios) every fan-out fleet
+                          watcher — demoted laggards included —
+                          converges to store ground truth via the
+                          resync path once drained fault-free, and the
+                          shared journal's peak occupancy stays inside
+                          the retention bound (a demoted watcher cannot
+                          pin the ring past the cap);
+- ``fallback_budget``   — scenario-pinned rate budgets over the honesty
+                          fallbacks AND (PR 12) ``admission_shed_rate``
+                          / ``watch_coalesce_rate``.
 
 A violation dumps a minimized repro bundle (scenario + seed + virtual
 time + offending objects + the event-log tail) under the run's repro
@@ -135,6 +150,7 @@ class Auditor:
         found.extend(self._check_event_consistency())
         found.extend(self._check_express())
         found.extend(self._check_pipeline())
+        found.extend(self._check_front_door(session))
         found.extend(self._check_fallback_budgets())
         if getattr(self.sim, "ha_enabled", False):
             found.extend(self._check_ha_fencing())
@@ -423,6 +439,74 @@ class Auditor:
                      "outstanding": sorted(lane.outstanding)[:20]}))
         return out
 
+    def _check_front_door(self, session: int) -> List[Violation]:
+        """Front-door overload invariants (front_door_storm's witnesses):
+
+        - shed-with-retry: every submission the intake gate shed
+          scheduled a retry (nothing dropped silently), and the gate's
+          shed ledger matches what the workload observed exactly;
+        - fan-out convergence: every fleet watcher — demoted laggards
+          included — converges to store ground truth once drained
+          fault-free (no phantom events, no lost deletes after
+          shedding/demotion), via the same reset/re-list resync path a
+          production client runs;
+        - bounded retention: the shared journal never holds entries past
+          its hard cap, and demoted watchers do not pin it (peak
+          occupancy is bounded by min(demote_lag, hard_cap))."""
+        out: List[Violation] = []
+        gate = getattr(self.sim, "front_door_gate", None)
+        wl = self.sim.workload
+        if gate is not None:
+            if wl.shed != wl.shed_retries:
+                out.append(Violation(
+                    "front_door_shed", "retry-ledger",
+                    f"{wl.shed} submissions shed but only "
+                    f"{wl.shed_retries} retries scheduled — a shed "
+                    f"submission was dropped silently",
+                    {"shed": wl.shed, "retries": wl.shed_retries}))
+            st = gate.stats()
+            if int(st["shed_total"]) != wl.shed:
+                out.append(Violation(
+                    "front_door_shed", "shed-ledger",
+                    f"intake gate shed {int(st['shed_total'])} vs "
+                    f"{wl.shed} observed by the submitter — sheds lost "
+                    f"or double-counted",
+                    {"gate": {k: v for k, v in sorted(st.items())
+                              if str(k).startswith(('shed', 'admitted'))},
+                     "workload_shed": wl.shed}))
+        fanout = getattr(self.sim, "watch_fanout", None)
+        if fanout is not None:
+            stats = fanout.watch_stats()
+            journal = stats["journal"]
+            bound = min(max(fanout.demote_lag, journal["cap"]),
+                        journal["hard_cap"])
+            if journal["peak_occupancy"] > bound:
+                out.append(Violation(
+                    "front_door_watchers", "journal-pinned",
+                    f"journal peak occupancy {journal['peak_occupancy']} "
+                    f"exceeded the retention bound {bound} — a slow or "
+                    f"demoted watcher pinned the ring",
+                    {"journal": journal, "demote_lag": fanout.demote_lag}))
+            # convergence runs at a SLOWER cadence than the session audit:
+            # catching every watcher up each session would quietly erase
+            # the very lag the storm is supposed to build, so the slow
+            # tail gets several sessions to fall behind (and be demoted)
+            # between proofs
+            every = int(self.cfg.get("fleet_audit_every", 4) or 1)
+            if session % every == 0:
+                for watcher in getattr(self.sim, "fleet", []):
+                    watcher.catch_up()
+                    diff = watcher.diff_vs_store()
+                    if diff["phantom"] or diff["missing"] or diff["stale"]:
+                        out.append(Violation(
+                            "front_door_watchers", watcher.watcher_id,
+                            f"fleet watcher {watcher.watcher_id} did not "
+                            f"converge to the store after catch-up "
+                            f"(demotion/coalescing lost or invented "
+                            f"state)",
+                            {k: v[:20] for k, v in diff.items()}))
+        return out
+
     def _check_fallback_budgets(self) -> List[Violation]:
         """Envelope budgets (ROADMAP item 4): the scenario's
         ``audit.budgets`` pins a maximum rate per fallback family —
@@ -447,6 +531,8 @@ class Auditor:
             "express_deferral_rate": rates.get("express_arrivals", 0),
             "pipeline_spec_discard_rate": rates.get(
                 "pipeline_spec_dispatched", 0),
+            "admission_shed_rate": rates.get("admission_attempts", 0),
+            "watch_coalesce_rate": rates.get("watch_events_handled", 0),
         }
         for name in sorted(budgets):
             spec = budgets[name]

@@ -193,6 +193,55 @@ class SimCluster:
             JournalMirror(self.store, kind, cap=int(cfg["mirrors"]["cap"]))
             for kind in cfg["mirrors"]["kinds"]]
 
+        # -- front door (cfg["front_door"]): admission backpressure on the
+        # store's Job intake plus a flow-controlled watcher fleet sharing
+        # one journal — the overload surfaces front_door_storm exercises
+        fd = cfg.get("front_door") or {}
+        self.front_door_gate = None
+        self.watch_fanout = None
+        self.fleet: List[JournalMirror] = []
+        self._fleet_slow: set = set()
+        self._fleet_skip = (0.0, 0.0)
+        intake_cfg = fd.get("intake")
+        if intake_cfg:
+            from volcano_tpu.admission.intake import (
+                IntakeGate, install_intake)
+
+            self.front_door_gate = IntakeGate(
+                rate_per_s=float(intake_cfg.get("rate_per_s", 5.0)),
+                burst=intake_cfg.get("burst"),
+                max_backlog=int(intake_cfg.get("max_backlog", 0)),
+                interactive_reserve=float(
+                    intake_cfg.get("interactive_reserve", 0.25)),
+                backlog_retry_s=float(
+                    intake_cfg.get("backlog_retry_s", 2.0)))
+            install_intake(self.store, self.front_door_gate)
+        watch_cfg = fd.get("watch") or {}
+        if watch_cfg.get("fleet"):
+            from volcano_tpu.store.flowcontrol import WatchFanout
+            from volcano_tpu.store.gateway import _WatchJournal
+
+            n = int(watch_cfg["fleet"])
+            kind = str(watch_cfg.get("kind", "Pod"))
+            journal = _WatchJournal(
+                self.store, kind, cap=int(watch_cfg.get("cap", 256)))
+            self.watch_fanout = WatchFanout(
+                journal,
+                demote_lag=watch_cfg.get("demote_lag"),
+                pin_factor=int(watch_cfg.get("pin_factor", 4)),
+                coalesce_min=int(watch_cfg.get("coalesce_min", 8)))
+            slow = min(int(watch_cfg.get("slow", 0)), n)
+            self._fleet_slow = set(range(n - slow, n))
+            self._fleet_skip = (
+                float(watch_cfg.get("skip_prob", 0.1)),
+                float(watch_cfg.get("slow_skip_prob", 0.9)))
+            for i in range(n):
+                cls = "interactive" if i % 3 == 0 else "batch"
+                self.fleet.append(JournalMirror(
+                    self.store, kind, journal=journal,
+                    fanout=self.watch_fanout,
+                    watcher_id=f"fleet-{i:05d}", watcher_class=cls))
+
         self.workload = Workload(self, cfg, self.rngs.stream("workload"))
         self.chaos = ChaosInjector(self, cfg.get("faults", {}), self.rngs)
         self.auditor = Auditor(self, cfg.get("audit", {}))
@@ -547,6 +596,11 @@ class SimCluster:
         self._last_stats = stats
         metrics.set_pending_pods(stats["pending"])
         self._publish_queue_depth()
+        if self.front_door_gate is not None:
+            # the demand signal the intake gate sheds on: pending pods
+            # the scheduler has not yet placed (published every cycle,
+            # exactly what a production loop would export)
+            self.front_door_gate.set_backlog(stats["pending"])
 
         if self._witness_on:
             # session-boundary probe: every cache-twin version that moved
@@ -564,6 +618,14 @@ class SimCluster:
                 rng=self.rngs.stream(f"mirror:{mirror.kind}"),
                 skip_prob=faults["skip_prob"],
                 error_prob=faults["error_prob"])
+        for i, watcher in enumerate(self.fleet):
+            # the deliberately-slow tail drains rarely — it must fall
+            # behind, get demoted, and converge back through resync
+            skip = (self._fleet_skip[1] if i in self._fleet_slow
+                    else self._fleet_skip[0])
+            watcher.drain(
+                rng=self.rngs.stream(f"fleet:{i}"),
+                skip_prob=skip, error_prob=faults["error_prob"])
 
         every = int(self.cfg["audit"].get("every_sessions", 1) or 0)
         audit_note = ""
@@ -799,6 +861,48 @@ class SimCluster:
             out["pipeline_spec_discards"] = stats.get("spec_discarded", 0)
             out["pipeline_spec_discard_rate"] = round(
                 stats.get("spec_discarded", 0) / max(dispatched, 1), 4)
+        if self.front_door_gate is not None:
+            st = self.front_door_gate.stats()
+            out["admission_attempts"] = int(st["attempts"])
+            out["admission_shed"] = int(st["shed_total"])
+            out["admission_shed_rate"] = round(
+                st["shed_total"] / max(st["attempts"], 1), 4)
+        if self.watch_fanout is not None:
+            c = self.watch_fanout.counters
+            handled = c["delivered"] + c["coalesced"]
+            out["watch_events_handled"] = handled
+            out["watch_events_coalesced"] = c["coalesced"]
+            out["watch_coalesce_rate"] = round(
+                c["coalesced"] / max(handled, 1), 4)
+        return out
+
+    def _front_door_summary(self) -> Optional[Dict]:
+        """Intake + fan-out accounting for the summary tail (None when
+        the scenario configures no front door)."""
+        if self.front_door_gate is None and self.watch_fanout is None:
+            return None
+        out: Dict = {}
+        jobs = self.workload
+        if self.front_door_gate is not None:
+            out["intake"] = self.front_door_gate.stats()
+            out["shed_submissions"] = jobs.shed
+            out["shed_retries_scheduled"] = jobs.shed_retries
+            out["shed_readmitted"] = jobs.shed_readmitted
+            horizon = max(self.vclock.now(), 1e-9)
+            out["submitted_per_sim_s"] = round(
+                (jobs.submitted + jobs.shed) / horizon, 3)
+            out["admitted_per_sim_s"] = round(jobs.submitted / horizon, 3)
+        if self.watch_fanout is not None:
+            out["watch"] = self.watch_fanout.watch_stats()
+            out["fleet"] = {
+                "watchers": len(self.fleet),
+                "slow": len(self._fleet_slow),
+                "resets": sum(m.resets for m in self.fleet),
+                "synthesized_deletes": sum(
+                    m.synthesized_deletes for m in self.fleet),
+                "skipped_drains": sum(
+                    m.skipped_drains for m in self.fleet),
+            }
         return out
 
     def _witness_summary(self) -> Dict:
@@ -870,6 +974,7 @@ class SimCluster:
                 "per_session": self._session_compiles[:64],
             },
             "fallbacks": self.fallback_rates(),
+            "front_door": self._front_door_summary(),
             "witness": (self._witness_summary()
                         if self._witness_on else None),
             "event_log_hash": self.engine.log_hash(),

@@ -17,7 +17,7 @@ converges to store ground truth (no phantoms, no lost deletes).
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 from volcano_tpu.api import codec
 from volcano_tpu.store.gateway import _WatchJournal
@@ -25,10 +25,23 @@ from volcano_tpu.store.store import Store, object_key
 
 
 class JournalMirror:
-    def __init__(self, store: Store, kind: str, cap: int = 512):
+    """``journal``/``fanout`` let many mirrors share ONE ring as a
+    watcher fleet: each instance then polls through the fan-out layer
+    (store/flowcontrol.WatchFanout) under its own ``watcher_id`` and
+    class, so demotion-to-resync lands on the SAME reset/re-list path
+    this consumer already implements."""
+
+    def __init__(self, store: Store, kind: str, cap: int = 512,
+                 journal: Optional[_WatchJournal] = None, fanout=None,
+                 watcher_id: Optional[str] = None,
+                 watcher_class: str = "default"):
         self.store = store
         self.kind = kind
-        self.journal = _WatchJournal(store, kind, cap=cap)
+        self.journal = journal if journal is not None \
+            else _WatchJournal(store, kind, cap=cap)
+        self.fanout = fanout
+        self.watcher_id = watcher_id or f"mirror-{kind}"
+        self.watcher_class = watcher_class
         self.since = 0
         # key -> resource_version of the last delivered state
         self.known: Dict[str, int] = {}
@@ -65,7 +78,11 @@ class JournalMirror:
 
     def poll_once(self) -> Tuple[int, bool]:
         """One non-blocking poll; returns (events_applied, reset_taken)."""
-        events, nxt, reset = self.journal.poll(self.since, 0.0)
+        if self.fanout is not None:
+            events, nxt, reset = self.fanout.poll_for(
+                self.watcher_id, self.since, 0.0, cls=self.watcher_class)
+        else:
+            events, nxt, reset = self.journal.poll(self.since, 0.0)
         if reset:
             self._relist()
             self.since = nxt

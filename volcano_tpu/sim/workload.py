@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 import yaml
 
 from volcano_tpu.api import objects
+from volcano_tpu.store.store import OverloadedError
 from volcano_tpu.scheduler.util.test_utils import (
     build_node,
     build_pod,
@@ -65,7 +66,13 @@ DEFAULTS: Dict = {
         "mem_choices": ["512Mi", "1Gi"],
         "gpu_prob": 0.0,
         "priorities": [1],
-        "arrival": {"kind": "none"},  # none | poisson | burst
+        "arrival": {"kind": "none"},  # none | poisson | burst | heavy_tail
+        # Pareto-ish job-size tail (ROADMAP item 5 realism slice): when
+        # set, `tasks` is redrawn heavy-tailed AFTER the base draws, so
+        # scenarios that do not opt in keep their exact sampling streams
+        # (same-seed hashes byte-identical).
+        # {alpha: 1.3, min_tasks: 1, cap: 64, min_member_frac: 1.0}
+        "heavy_tail_sizes": None,
         "service_s": [20.0, 120.0],
         "fail_prob": 0.0,
         "cancel_prob": 0.0,
@@ -156,13 +163,30 @@ def scale_scenario(cfg: Dict, scale: float) -> Dict:
     if wl["max_jobs"] is not None:
         wl["max_jobs"] = max(int(wl["max_jobs"] * scale), 1)
     arrival = wl["arrival"]
-    if arrival.get("kind") == "poisson":
+    if arrival.get("kind") in ("poisson", "heavy_tail"):
         arrival["rate_per_s"] = arrival.get("rate_per_s", 1.0) * scale
     elif arrival.get("kind") == "burst":
         arrival["jobs"] = max(int(arrival.get("jobs", 1) * scale), 1)
     for fault in out.get("faults", {}).values():
         if isinstance(fault, dict) and "burst" in fault:
             fault["burst"] = max(int(fault["burst"] * scale), 1)
+    fd = out.get("front_door") or {}
+    intake = fd.get("intake")
+    if intake:
+        # the demand scales, so the gate must scale with it or the
+        # demand/capacity ratio — what makes the storm a storm — breaks
+        intake["rate_per_s"] = max(
+            float(intake.get("rate_per_s", 1.0)) * scale, 0.1)
+        if intake.get("burst") is not None:
+            intake["burst"] = max(float(intake["burst"]) * scale, 1.0)
+        if intake.get("max_backlog"):
+            intake["max_backlog"] = max(
+                int(intake["max_backlog"] * scale), 2)
+    watch = fd.get("watch")
+    if watch and watch.get("fleet"):
+        watch["fleet"] = max(int(watch["fleet"] * scale), 4)
+        if watch.get("slow"):
+            watch["slow"] = max(int(watch["slow"] * scale), 1)
     return out
 
 
@@ -213,6 +237,19 @@ def sample_job_shape(cfg: Dict, rng) -> Dict:
         "resubmit": rng.random() < wl["resubmit_prob"],
         "interactive": False,
     }
+    ht = wl.get("heavy_tail_sizes")
+    if ht:
+        # heavy-tailed job width (Borg/Alibaba-shaped: most jobs tiny, a
+        # fat tail of wide gangs). Draws happen ONLY when the scenario
+        # opts in — existing scenarios keep their exact streams.
+        alpha = float(ht.get("alpha", 1.3))
+        lo_t = int(ht.get("min_tasks", 1))
+        cap_t = int(ht.get("cap", 64))
+        tasks = min(lo_t + int(rng.paretovariate(alpha)) - 1, cap_t)
+        shape["tasks"] = max(tasks, 1)
+        frac = float(ht.get("min_member_frac", 1.0))
+        shape["min_member"] = max(
+            1, min(shape["tasks"], int(round(shape["tasks"] * frac))))
     inter = wl.get("interactive")
     if inter:
         # extra draws happen ONLY when the scenario opts in, so existing
@@ -282,6 +319,12 @@ class Workload:
         self.completed = 0
         self.failed = 0
         self.cancelled = 0
+        # intake-gate backpressure accounting (front-door scenarios):
+        # every shed submission MUST schedule a retry — the auditor's
+        # rejected-with-retry, never-dropped-silently invariant
+        self.shed = 0
+        self.shed_retries = 0
+        self.shed_readmitted = 0
 
     # -- start -------------------------------------------------------------
 
@@ -313,6 +356,17 @@ class Workload:
         if kind == "poisson":
             delay = self.rng.expovariate(float(arrival["rate_per_s"]))
             self.sim.engine.schedule_in(delay, "arrival", self._on_arrival)
+        elif kind == "heavy_tail":
+            # Poisson base modulated by periodic burst waves (the diurnal
+            # / thundering-herd shape real cluster traces show): inside a
+            # wave the instantaneous rate multiplies by wave_factor
+            rate = float(arrival["rate_per_s"])
+            every = float(arrival.get("wave_every_s", 30.0))
+            width = float(arrival.get("wave_s", every / 4.0))
+            if every > 0 and (self.sim.vclock.now() % every) < width:
+                rate *= float(arrival.get("wave_factor", 5.0))
+            delay = self.rng.expovariate(max(rate, 1e-9))
+            self.sim.engine.schedule_in(delay, "arrival", self._on_arrival)
         elif kind == "burst":
             self.sim.engine.schedule_in(
                 float(arrival["every_s"]), "arrival-burst",
@@ -334,14 +388,38 @@ class Workload:
     # -- lifecycle ---------------------------------------------------------
 
     def _submit(self, shape: Optional[Dict] = None,
-                base: Optional[str] = None) -> str:
+                base: Optional[str] = None, _retry: int = 0) -> str:
         self._counter += 1
         if shape is None:
             shape = sample_job_shape(self.cfg, self.rng)
         name = base or f"sim-{self._counter:06d}"
         job = build_sim_job(name, shape, self.wl["ttl_s"])
-        self.sim.store.create(job)
         key = f"{shape['namespace']}/{name}"
+        try:
+            self.sim.store.create(job)
+        except OverloadedError as e:
+            # the intake gate shed this submission: rejected-with-retry.
+            # Re-submit the SAME job no earlier than the server's
+            # retry_after, escalating exponentially on repeat sheds (the
+            # client-side backoff a RemoteStore submitter runs) so a
+            # storm of shed retries cannot hold the bucket at zero —
+            # and nothing is ever dropped silently (the auditor balances
+            # shed == retries scheduled).
+            delay = min(max(e.retry_after, 0.05) * (1.7 ** min(_retry, 8)),
+                        60.0)
+            self.shed += 1
+            self.shed_retries += 1
+            self.sim.engine.schedule_in(
+                delay, "intake-retry",
+                lambda s=shape, n=name, a=_retry + 1: self._submit(
+                    shape=s, base=n, _retry=a))
+            self.sim.engine.log_event(
+                "shed",
+                f"{key} reason={e.reason} "
+                f"retry_in={round(delay, 3)}")
+            return f"{key} shed"
+        if _retry:
+            self.shed_readmitted += 1
         self.jobs[key] = {"shape": shape, "state": "submitted"}
         self.submitted += 1
         self.sim.engine.log_event(

@@ -8,6 +8,7 @@ from volcano_tpu.store.store import (
     FencedError,
     FencedStoreView,
     NotFoundError,
+    OverloadedError,
     Store,
     WatchHandler,
 )

@@ -18,7 +18,11 @@ cluster process from outside:
 
 Admission runs server-side exactly as for in-process writes (store.create
 applies mutators/validators); AdmissionError maps to 422, ConflictError
-to 409, NotFoundError to 404. Objects travel as api/codec.py envelopes.
+to 409, NotFoundError to 404, and OverloadedError — the intake gate's
+admission backpressure (admission/intake.py) — to 429 with a Retry-After
+header and a ``retry_after`` body field, so a shed submission is always
+rejected-with-retry, never dropped. Objects travel as api/codec.py
+envelopes.
 
 Watch streams make remote informer clients possible — the reference's
 controllers/scheduler are informer clients of the API server
@@ -28,7 +32,12 @@ the in-process Store.watch. Protocol: each kind gets a server-side journal
 (created on first watch, seeded with ADDED for existing objects); clients
 poll `since=<seq>` and receive `{"events": [...], "next": seq}`; a client
 that fell behind a trimmed journal receives `{"reset": true, "next": seq}`
-and must re-list before resuming.
+and must re-list before resuming. A poll naming `watcher=<id>` (and
+optionally `class=interactive|batch|default`) opts into the fan-out
+flow-control layer (store/flowcontrol.py): per-watcher lag accounting,
+batched delivery-side coalescing, and slow-watcher demotion — a deep
+laggard receives the SAME reset contract instead of an unbounded
+catch-up stream, and resumes via re-list with its resumable cursor.
 
 Auth/TLS: pass ``token=`` to require `Authorization: Bearer <token>` on
 every request except /healthz (the reference's API surface is an
@@ -50,7 +59,8 @@ from urllib.parse import parse_qs, urlsplit
 from volcano_tpu.api import codec
 from volcano_tpu.scheduler.httpserver import _parse_address
 from volcano_tpu.store.store import (
-    AdmissionError, ConflictError, NotFoundError, Store, WatchHandler)
+    AdmissionError, ConflictError, NotFoundError, OverloadedError, Store,
+    WatchHandler)
 
 logger = logging.getLogger(__name__)
 
@@ -81,10 +91,24 @@ class _WatchJournal:
         self.start = 0  # sequence number of events[0]
         self.cap = cap
         self.squashed = 0  # MODIFIED events coalesced away
+        self.appended = 0  # entries ever appended (post-squash)
+        self.trimmed = 0   # entries dropped off the ring start
+        self.peak_occupancy = 0
         self._served_to = 0  # highest seq ever returned by a poll
         # key -> (seq, type) of that key's latest ring entry, the squash
         # candidate index; pruned lazily against the ring start
         self._latest: dict = {}
+        # optional flow-control layer (store/flowcontrol.WatchFanout):
+        # consulted at trim time so live laggards extend retention up to
+        # its hard cap, and demoted/stalled watchers cannot pin the ring
+        self.fanout = None
+        # shared-slice cache: watchers at the same cursor receive the
+        # SAME immutable tuple, so N watchers cost O(events + N), not
+        # O(events x N) copies; invalidated whenever the ring moves.
+        # Safe to share: poll marks entries served (immutable) before
+        # caching, so no later squash can rewrite a cached entry.
+        self._slice_cache: dict = {}
+        self._slice_gen = (-1, -1)
         store.watch(kind, WatchHandler(
             added=lambda new: self._append("ADDED", None, new),
             updated=lambda old, new: self._append("MODIFIED", old, new),
@@ -94,8 +118,13 @@ class _WatchJournal:
     def _append(self, etype: str, old, new) -> None:
         from volcano_tpu.store.store import object_key
 
+        import time as _time
+
         key = object_key(new if new is not None else old)
-        entry = {"type": etype, "key": key}
+        # append-time stamp (wall monotonic, observability only — never a
+        # scheduling input): the fan-out bench derives per-watcher
+        # delivery latency from it
+        entry = {"type": etype, "key": key, "ts": _time.monotonic()}
         if new is not None:
             entry["object"] = codec.envelope(new)
         if old is not None:
@@ -115,15 +144,62 @@ class _WatchJournal:
                         self.cond.notify_all()
                         return
             self.events.append(entry)
+            self.appended += 1
+            self._slice_cache.clear()
             self._latest[key] = (self.start + len(self.events) - 1, etype)
             if len(self.events) > self.cap:
-                drop = len(self.events) - self.cap
-                del self.events[:drop]
-                self.start += drop
+                # soft-cap trim. With a fanout attached, a LIVE laggard
+                # may lower the floor (bounded retention up to the
+                # fanout's hard cap) — and the fanout demotes any watcher
+                # lagging past demote_lag right here, so a stalled
+                # watcher can never pin entries past the cap.
+                floor = self.start + len(self.events) - self.cap
+                if self.fanout is not None:
+                    floor = self.fanout.retain_floor(floor)
+                drop = floor - self.start
+                if drop > 0:
+                    del self.events[:drop]
+                    self.start = floor
+                    self.trimmed += drop
+            if len(self.events) > self.peak_occupancy:
+                self.peak_occupancy = len(self.events)
             if len(self._latest) > 4 * self.cap:
                 self._latest = {k: v for k, v in self._latest.items()
                                 if v[0] >= self.start}
             self.cond.notify_all()
+
+    def attach_fanout(self, fanout) -> None:
+        """Install the flow-control layer (store/flowcontrol.WatchFanout);
+        its retain_floor() hook runs inside every over-cap trim."""
+        with self.cond:
+            self.fanout = fanout
+
+    def force_reset(self) -> int:
+        """Freeze squash eligibility through the current head and return
+        it — the demote-to-resync twin of poll()'s reset path (a watcher
+        told to re-list must never lose a final state to a squash below
+        its new cursor)."""
+        with self.cond:
+            end = self.start + len(self.events)
+            self._served_to = max(self._served_to, end)
+            return end
+
+    def stats(self) -> dict:
+        """Occupancy + lifetime accounting (the journal half of
+        ``watch_stats()``)."""
+        with self.cond:
+            return {
+                "occupancy": len(self.events),
+                "cap": self.cap,
+                "hard_cap": (self.fanout.hard_cap
+                             if self.fanout is not None else self.cap),
+                "peak_occupancy": self.peak_occupancy,
+                "start": self.start,
+                "end": self.start + len(self.events),
+                "appended": self.appended,
+                "squashed": self.squashed,
+                "trimmed": self.trimmed,
+            }
 
     def poll(self, since: int, timeout: float):
         """Events with seq >= since, blocking up to ``timeout`` when none
@@ -153,7 +229,16 @@ class _WatchJournal:
                 if since < end:
                     # entries handed out become immutable (the squash gate)
                     self._served_to = max(self._served_to, end)
-                    return list(self.events[since - self.start:]), end, False
+                    # shared-slice fast path: every watcher at this cursor
+                    # gets the SAME tuple until the ring moves again
+                    if self._slice_gen != (self.start, end):
+                        self._slice_cache.clear()
+                        self._slice_gen = (self.start, end)
+                    batch = self._slice_cache.get(since)
+                    if batch is None:
+                        batch = tuple(self.events[since - self.start:])
+                        self._slice_cache[since] = batch
+                    return batch, end, False
                 if deadline is None:
                     import time as _time
 
@@ -179,9 +264,13 @@ class ApiGateway:
                  token: Optional[str] = None,
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
-                 journal_cap: int = 4096):
+                 journal_cap: int = 4096,
+                 watch_demote_lag: Optional[int] = None,
+                 watch_pin_factor: int = 4):
         self.store = store
         self._journal_cap = journal_cap
+        self._watch_demote_lag = watch_demote_lag
+        self._watch_pin_factor = watch_pin_factor
         self._address = _parse_address(address, default_host="127.0.0.1")
         self._token = token
         self._tls_cert = tls_cert
@@ -189,6 +278,7 @@ class ApiGateway:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._journals: Dict[str, _WatchJournal] = {}
+        self._fanouts: Dict[str, object] = {}
         self._journals_lock = threading.Lock()
 
     @property
@@ -205,6 +295,35 @@ class ApiGateway:
                     self.store, kind, cap=self._journal_cap)
             return j
 
+    def _fanout(self, kind: str):
+        """Per-kind flow-control layer, created on the first poll that
+        names a watcher id (clients that never do keep the bare journal
+        protocol — fully backward compatible)."""
+        journal = self._journal(kind)
+        with self._journals_lock:
+            f = self._fanouts.get(kind)
+            if f is None:
+                from volcano_tpu.store.flowcontrol import WatchFanout
+
+                f = self._fanouts[kind] = WatchFanout(
+                    journal, demote_lag=self._watch_demote_lag,
+                    pin_factor=self._watch_pin_factor)
+            return f
+
+    def watch_stats(self) -> Dict[str, dict]:
+        """Per-kind journal + fan-out accounting (the front-door twin of
+        the store's fence_stats): occupancy, squash/coalesce tallies,
+        per-class watcher lag and demotions."""
+        with self._journals_lock:
+            journals = dict(self._journals)
+            fanouts = dict(self._fanouts)
+        out: Dict[str, dict] = {}
+        for kind in sorted(journals):
+            f = fanouts.get(kind)
+            out[kind] = (f.watch_stats() if f is not None
+                         else {"journal": journals[kind].stats()})
+        return out
+
     def start(self) -> "ApiGateway":
         store = self.store
         gw = self
@@ -216,11 +335,14 @@ class ApiGateway:
                 "a non-loopback --api-address requires --api-token")
 
         class Handler(BaseHTTPRequestHandler):
-            def _reply(self, code: int, payload) -> None:
+            def _reply(self, code: int, payload,
+                       headers: Optional[dict] = None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for key, value in (headers or {}).items():
+                    self.send_header(key, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -289,8 +411,20 @@ class ApiGateway:
                                 "error": "since/timeout must be numeric",
                                 "type": "ValueError"})
                             return
-                        events, nxt, reset = gw._journal(segs[1]).poll(
-                            since, timeout)
+                        watcher = q.get("watcher")
+                        if watcher:
+                            # flow-controlled path: per-watcher cursor
+                            # accounting, batched coalescing, slow-watcher
+                            # demotion to snapshot-resync (the reset below
+                            # carries the same re-list contract)
+                            events, nxt, reset = gw._fanout(segs[1]).poll_for(
+                                watcher, since, timeout,
+                                cls=q.get("class", "default"))
+                            events = list(events)
+                        else:
+                            events, nxt, reset = gw._journal(segs[1]).poll(
+                                since, timeout)
+                            events = list(events)
                         payload = {"events": events, "next": nxt}
                         if reset:
                             payload["reset"] = True
@@ -356,6 +490,16 @@ class ApiGateway:
                         self._reply(201, codec.envelope(created))
                     else:
                         self._reply(404, {"error": "not found"})
+                except OverloadedError as e:
+                    # admission backpressure (admission/intake.py): 429 +
+                    # retry-after, the rejected-with-retry contract — a
+                    # shed submission is never silently dropped
+                    self._reply(429, {
+                        "error": str(e), "type": "OverloadedError",
+                        "reason": e.reason,
+                        "retry_after": e.retry_after,
+                    }, headers={"Retry-After":
+                                f"{max(e.retry_after, 0.0):.3f}"})
                 except AdmissionError as e:
                     self._error(422, e)
                 except ConflictError as e:

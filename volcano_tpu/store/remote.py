@@ -31,7 +31,7 @@ from typing import Dict, List, Optional
 from volcano_tpu.api import codec
 from volcano_tpu.store.store import (
     CLUSTER_SCOPED, AdmissionError, ConflictError, FencedError,
-    NotFoundError, WatchHandler)
+    NotFoundError, OverloadedError, WatchHandler)
 
 logger = logging.getLogger(__name__)
 
@@ -56,7 +56,8 @@ class RemoteEvent:
 class RemoteStore:
     def __init__(self, server: str, timeout: float = 10.0,
                  token: Optional[str] = None,
-                 tls_verify: bool = True):
+                 tls_verify: bool = True,
+                 overload_retries: int = 2):
         if "://" not in server:
             server = "http://" + server
         self.base = server.rstrip("/")
@@ -80,6 +81,14 @@ class RemoteStore:
         self._watch_stats: Dict[str, float] = {
             "polls": 0, "poll_errors": 0, "resets": 0,
             "relist_retries": 0, "backoff_s": 0.0, "max_backoff_s": 0.0}
+        # 429 handling: how many times create() re-tries a shed
+        # submission before surfacing the typed OverloadedError; each
+        # pause honors max(server retry_after, jittered Backoff delay)
+        self.overload_retries = int(overload_retries)
+        self._overload_backoff = None  # lazy (degrade import)
+        self._overload_lock = threading.Lock()
+        self._overload_stats: Dict[str, float] = {
+            "overloaded": 0, "retries": 0, "backoff_s": 0.0}
         self._event_buf: List[dict] = []
         self._event_lock = threading.Lock()
         self._event_wake = threading.Event()
@@ -126,6 +135,15 @@ class RemoteStore:
                 raise ConflictError(msg) from None
             if e.code == 422:
                 raise AdmissionError(msg) from None
+            if e.code == 429:
+                # the intake gate's backpressure survives the HTTP hop
+                # typed: the caller sees the same rejected-with-retry
+                # contract as an in-process submitter
+                raise OverloadedError(
+                    msg,
+                    retry_after=float(detail.get("retry_after", 1.0)),
+                    reason=str(detail.get("reason", "overloaded"))) \
+                    from None
             raise RemoteStoreError(f"{method} {url}: {e.code} {msg}") from None
         except urllib.error.URLError as e:
             raise RemoteStoreError(f"{method} {url}: {e.reason}") from None
@@ -140,11 +158,48 @@ class RemoteStore:
 
     # -- verbs (Store surface subset) ---------------------------------------
 
+    def _overload_pause(self, exc: OverloadedError) -> None:
+        """Honor a 429's retry-after hint through the standing jittered
+        Backoff (scheduler/degrade.py) — a storm of shed clients must
+        retry de-correlated AND no earlier than the server asked."""
+        with self._overload_lock:
+            if self._overload_backoff is None:
+                from volcano_tpu.scheduler.degrade import Backoff
+
+                self._overload_backoff = Backoff(
+                    f"intake-retry:{self.base}", base=0.05, cap=15.0)
+            delay = max(exc.retry_after,
+                        self._overload_backoff.next_delay())
+            self._overload_stats["retries"] += 1
+            self._overload_stats["backoff_s"] += delay
+        time.sleep(delay)
+
+    def intake_stats(self) -> Dict[str, float]:
+        """429/backpressure client-side tallies (watch_stats() twin)."""
+        with self._overload_lock:
+            out = dict(self._overload_stats)
+        out["backoff_s"] = round(out["backoff_s"], 3)
+        return out
+
     def create(self, obj, epoch: Optional[int] = None) -> object:
         kind = type(obj).KIND
         q = {"epoch": str(epoch)} if epoch is not None else None
-        out = self._request("POST", f"/apis/{kind}", codec.envelope(obj), q)
-        return codec.from_envelope(out)
+        attempt = 0
+        while True:
+            try:
+                out = self._request("POST", f"/apis/{kind}",
+                                    codec.envelope(obj), q)
+                with self._overload_lock:
+                    if self._overload_backoff is not None:
+                        self._overload_backoff.reset()
+                return codec.from_envelope(out)
+            except OverloadedError as e:
+                with self._overload_lock:
+                    self._overload_stats["overloaded"] += 1
+                if attempt >= self.overload_retries:
+                    raise
+                attempt += 1
+                self._overload_pause(e)
 
     def update(self, obj, expect_version: Optional[int] = None,
                epoch: Optional[int] = None) -> object:
@@ -346,7 +401,9 @@ class RemoteStore:
     # -- watch (informer twin) ----------------------------------------------
 
     def watch(self, kind: str, handler: WatchHandler,
-              replay: bool = True, poll_timeout: float = 20.0) -> None:
+              replay: bool = True, poll_timeout: float = 20.0,
+              watcher_id: Optional[str] = None,
+              watcher_class: str = "default") -> None:
         """Long-poll the gateway's /watch/{kind} journal on a background
         thread, dispatching the in-process WatchHandler callbacks.
 
@@ -369,16 +426,28 @@ class RemoteStore:
         watchers must see de-correlated retries, not a synchronized herd.
         Retry/backoff tallies surface through ``watch_stats()``.
 
+        With ``watcher_id`` the poller opts into the gateway's fan-out
+        flow control (store/flowcontrol.py): the server tracks this
+        watcher's lag per ``watcher_class``, coalesces its catch-up
+        batches, and may demote it to snapshot-resync — which arrives
+        as the SAME reset this loop already handles, so nothing extra
+        is needed client-side.
+
         Callbacks run on the poll thread — the same "handler runs on a
         foreign thread" contract as the in-process store, whose handlers
         run on the writer's thread."""
         from volcano_tpu.scheduler.degrade import Backoff
         from volcano_tpu.store.store import object_key
 
+        extra_q = {}
+        if watcher_id:
+            extra_q = {"watcher": str(watcher_id),
+                       "class": str(watcher_class)}
         since = 0
         if not replay:
             out = self._request("GET", f"/watch/{kind}",
-                                query={"since": "0", "timeout": "0"})
+                                query={"since": "0", "timeout": "0",
+                                       **extra_q})
             since = int(out.get("next", 0))
 
         # capture THIS registration's stop event: stop_watches replaces
@@ -401,7 +470,7 @@ class RemoteStore:
                     out = self._request(
                         "GET", f"/watch/{kind}",
                         query={"since": str(since),
-                               "timeout": str(poll_timeout)},
+                               "timeout": str(poll_timeout), **extra_q},
                         timeout=poll_timeout + self.timeout)
                     self._bump_watch_stat("polls")
                     poll_backoff.reset()

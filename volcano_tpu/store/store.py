@@ -55,6 +55,24 @@ class AdmissionError(ValueError):
     """An admission validator rejected the request."""
 
 
+class OverloadedError(RuntimeError):
+    """The front door is shedding load: the request was rejected WITH a
+    retry hint, never dropped silently.
+
+    Raised by the intake gate (admission/intake.py) when the token-bucket
+    rate or the backlog bound is exhausted; carries ``retry_after``
+    (seconds — the earliest retry that can succeed under the current
+    refill rate) and ``reason`` ("rate" | "backlog"). The gateway maps it
+    to HTTP 429 + Retry-After; RemoteStore re-raises it typed and can
+    honor the hint through degrade.Backoff."""
+
+    def __init__(self, message: str, retry_after: float = 1.0,
+                 reason: str = "overloaded"):
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+        self.reason = str(reason)
+
+
 # Kinds without a namespace (keyed by bare name).
 CLUSTER_SCOPED = {"Node", "Queue", "PriorityClass", "PersistentVolume"}
 

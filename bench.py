@@ -306,14 +306,34 @@ def run_config(cfg: int, scale: float, backend: str, serial_budget: float,
         # warm cache measure that: the first reconciles the placements the
         # mirror flush synced, the rest are the no-churn steady state.
         incr_open, incr_close = [], []
+        steady_encode, steady_replica = [], {}
         for _ in range(3):
             w2 = _session_once(cache, tpu_tiers, actions, mesh=mesh)
             incr_open.append(round(w2["open_s"] * 1e3, 3))
             incr_close.append(round(w2["close_s"] * 1e3, 3))
+            p2 = w2["profile"]
+            steady_encode.append(round(p2.get("encode_s", 0.0) * 1e3, 3))
+            steady_replica.update({
+                k: p2[k] for k in ("encode_reused", "h2d_puts",
+                                   "replica_rebuilds",
+                                   "replica_scatter_rows",
+                                   "tpu_replica_scatter_ms",
+                                   "replica_epoch") if k in p2})
         out["tpu_incr_open_ms"] = incr_open
         out["tpu_incr_close_ms"] = incr_close
         out["tpu_incr_open_close_ms"] = round(statistics.median(
             o + c for o, c in zip(incr_open, incr_close)), 3)
+        # device-replica steady state (ROADMAP item 2): the incr sessions
+        # above ride the standing replica — session 1 reconciles the bulk
+        # placements (a scatter/dense diff), sessions 2-3 are the no-churn
+        # steady state whose encode should be ~zero (whole-prepare reuse,
+        # h2d_puts == 0). The median over the stable tail is the tracked
+        # steady-state encode figure.
+        out["tpu_steady_encode_ms"] = steady_encode
+        out["tpu_steady_state"] = dict(
+            steady_replica,
+            encode_ms=round(statistics.median(steady_encode[1:]
+                                              or steady_encode), 3))
         out["snap_keeper_stats"] = dict(cache.snap_keeper.stats)
         out["tpu_profile"] = {
             k: (round(v, 4) if isinstance(v, float) else v)
@@ -1025,7 +1045,14 @@ def _measure_floor_ms(probes: int = 5):
     (max - min) is recorded next to it, and the annotation carries every
     probe's wall plus the counted sync-point/D2H budget, so a drifting
     link is attributable in the record instead of silently reshaping the
-    headline."""
+    headline.
+
+    The FIRST probe after the drain fence is systematically unlike the
+    rest (cfg6 in BENCH_r05: a ~56 ms first probe against a ~96 ms stable
+    tail — the fence leaves the link/device queue in a state no later
+    probe sees), so it is discarded from the aggregate and carried in the
+    annotation as first_probe_ms: the median and spread come from the
+    stable tail only."""
     import statistics
 
     counters = {}
@@ -1043,11 +1070,13 @@ def _measure_floor_ms(probes: int = 5):
 
         scope = scope()
     with scope:
-        samples = [s for s in (_probe_once_ms() for _ in range(probes))
-                   if s is not None]
-    if not samples:
+        raw = [s for s in (_probe_once_ms() for _ in range(probes + 1))
+               if s is not None]
+    if not raw:
         return None, None, None
+    first, samples = raw[0], (raw[1:] or raw)
     note = {"probes_ms": samples,
+            "first_probe_ms": first,
             "sync_points": counters.get("tpu_sync_points"),
             "d2h_fetches": counters.get("tpu_d2h_fetches")}
     return (round(statistics.median(samples), 3),
@@ -1369,6 +1398,12 @@ def main() -> int:
             "e2e_ms": r.get("tpu_e2e_median_ms", r.get("serial_e2e_ms")),
             "speedup": round(r.get("speedup", 0.0), 3),
         }
+        # steady-state encode column (device replica, ROADMAP item 2):
+        # the delta-fed figure the replica work binds on, next to the
+        # cold-ish warm-session headline
+        st = r.get("tpu_steady_state")
+        if st is not None:
+            entry["steady_encode_ms"] = st.get("encode_ms")
         if r["config"] == 4 and "tpu_action_ms" in r:
             entry["action_ms"] = {
                 k: v for k, v in r["tpu_action_ms"].items()

@@ -75,3 +75,12 @@ def sharded_stage(arrays, spec):
     width = per_shard(nb, 8)
     sl = np.zeros((width, 2))
     return solve_rounds(spec, {"node_idle": sl})
+
+
+def replica_patch(dev, rows, arrays):
+    # the replica's dirty-row scatter: the index is padded by the blessed
+    # bucket helper, so churn of any size up to the bucket reuses ONE
+    # compiled row-scatter program
+    idx = bucket_pad_rows(rows)
+    vals = {k: arrays[k][idx] for k in arrays}
+    return scatter_rows(dev, idx, vals)

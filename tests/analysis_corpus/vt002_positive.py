@@ -62,3 +62,10 @@ def sharded_stage(arrays, live_nodes, spec):
     width = len(live_nodes) // 8
     sl = np.zeros((width, 2))  # vclint-expect: VT002
     return solve_rounds(spec, {"node_idle": sl})
+
+
+def replica_patch(dev, rows, vals):
+    # the replica's dirty-row scatter: a raw churn count reaching the
+    # index shape re-keys the shared row-scatter program on every delta
+    idx = np.zeros((len(rows),), np.int32)  # vclint-expect: VT002
+    return scatter_rows(dev, idx, vals)

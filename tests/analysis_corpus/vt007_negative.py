@@ -66,3 +66,20 @@ class GoodFanout:
     def unregister(self, wid):
         self.watchers.pop(wid, None)
         self.stats_gen += 1
+
+
+class GoodReplica:
+    """PR 13 device-replica scope: every standing-buffer swap bumps
+    replica_epoch, the channel cache.pipeline_fingerprint seals."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.replica_epoch = 0
+
+    def adopt(self, name, buf):
+        self.nodes[name] = buf
+        self.replica_epoch += 1
+
+    def invalidate(self):
+        self.nodes.pop("stale", None)
+        self.replica_epoch += 1

@@ -45,3 +45,16 @@ class MiniFanout:
 
     def drop_unmarked(self, wid):
         del self.watchers[wid]  # vclint-expect: VT007
+
+
+class MiniReplica:
+    """PR 13 device-replica scope: standing-buffer swaps must move the
+    replica epoch (the sealed consumer-invalidation channel) or a
+    memoized whole-encode prepare replays against the old content."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.replica_epoch = 0
+
+    def adopt_unbumped(self, name, buf):
+        self.nodes[name] = buf  # vclint-expect: VT007

@@ -20,3 +20,12 @@ class LeakyCacheFingerprint:
     def pipeline_fingerprint(self):
         # seals bar_epoch but neither foo_epoch nor baz_gen
         return (self.keeper.bar_epoch,)
+
+
+class LeakyReplica:
+    """PR 13 device-replica scope: device content that moves behind an
+    unsealed channel — a speculative prepare sealed before the scatter
+    would replay stale standing buffers."""
+
+    def scatter(self):
+        self.buffer_seq += 1  # vclint-expect: VT009

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import copy
 import json
+import os
 import subprocess
 import sys
 
@@ -471,6 +472,59 @@ class TestCfg5Scale:
         assert s["audit"]["violations"] == 0, s["audit"]
         assert s["faults"].get("node_flap", 0) > 10
         assert s["mirrors"]["Pod"]["resets"] > 10
+
+
+# ---------------------------------------------------------------------------
+# 5. device replica under chaos (PR 13): the standing device copy of
+#    cluster state rides the soak's rounds-pinned conf — coherence and
+#    rebuild-rate budgets audited, and the replica must be INVISIBLE to
+#    the event log (same seed, flag on vs off ⇒ byte-identical hash)
+# ---------------------------------------------------------------------------
+
+
+def _run_soak(seed, replica, duration):
+    cfg = scale_scenario(load_scenario("chaos_soak"), 0.2)
+    old = os.environ.get("VOLCANO_TPU_REPLICA")
+    os.environ["VOLCANO_TPU_REPLICA"] = replica
+    try:
+        return SimCluster(cfg, seed=seed, repro_dir=None).run(
+            duration=duration)
+    finally:
+        if old is None:
+            os.environ.pop("VOLCANO_TPU_REPLICA", None)
+        else:
+            os.environ["VOLCANO_TPU_REPLICA"] = old
+
+
+class TestDeviceReplicaSim:
+    def test_soak_replica_clean_and_flag_invisible_to_event_log(self):
+        """Shortened chaos_soak with the replica standing (default) vs
+        killed (VOLCANO_TPU_REPLICA=0), same seed: the on-run must hold
+        zero violations — which now includes replica_coherence and the
+        replica_rebuild_rate budget — while serving real scatters across
+        scheduler restarts; and the two event logs must be
+        byte-identical, because the replica is a pure staging substrate
+        that may never change WHAT gets scheduled."""
+        a = _run_soak(seed=5, replica="1", duration=240.0)
+        b = _run_soak(seed=5, replica="0", duration=240.0)
+
+        assert a["audit"]["violations"] == 0, a["audit"]
+        rep = a["replica"]
+        assert rep and rep["serves"] > 0, rep
+        # restarts/chaos exercised the rebuild ladder (every fresh cache
+        # generation's first serve is cold) AND the delta path carried
+        # steady state between faults
+        assert rep["rebuilds"].get("cold", 0) >= 1, rep
+        fb = a["fallbacks"]
+        assert fb["replica_serves"] == rep["serves"]
+        assert "replica_rebuild_rate" in fb, fb
+
+        # flag-off: no replica anywhere in the run...
+        assert b["replica"] is None, b["replica"]
+        assert "replica_serves" not in b["fallbacks"]
+        # ...and the schedule itself is untouched by the flag
+        assert a["event_log_hash"] == b["event_log_hash"]
+        assert a["binds"] == b["binds"]
 
 
 # ---------------------------------------------------------------------------

@@ -82,6 +82,10 @@ EFFECT_ATTR_BUMPS = {
     # on stats_gen, so every watcher-map mutation must bump it or the
     # aggregate snapshot goes silently stale
     "stats_gen": "frontdoor_stats",
+    # device replica (ops/replica.py): scatter/rebuild/adoption sites
+    # bump replica_epoch — the channel the whole-encode memo and the
+    # speculation fingerprint key on
+    "replica_epoch": "replica_epoch",
 }
 
 # snapshot-bearing mutating method calls (receiver-attr name)
@@ -118,6 +122,9 @@ DEVICE_DISPATCH = {
     "solve_rounds_packed", "solve_rounds", "solve_allocate",
     "solve_express", "solve_preempt", "solve_reclaim", "solve_backfill",
     "solve_fused_chain", "start_fetch", "device_put", "block_until_ready",
+    # the replica/express shared row-scatter (ops/replica.py) enqueues
+    # device work exactly like a solve dispatch
+    "scatter_rows",
 }
 
 # blocking network sends for the VT008 front-door scope: under the

@@ -184,7 +184,10 @@ class BucketShape(Rule):
                 "*/ops/shard.py",
                 # the express lane dispatches its own jitted round with
                 # bucket-keyed task/job axes and a top_k candidate window
-                "*/express/*.py")
+                "*/express/*.py",
+                # the device replica's scatter kernels: the row-index
+                # bucket ladder is jit-static exactly like a pad size
+                "*/ops/replica.py")
 
     SANITIZERS = {"_bucket"}
     BLESSED_CALLS = {"pad_encoded",
@@ -206,7 +209,12 @@ class BucketShape(Rule):
                      # extent by the device count — per-shard shapes
                      # derived through them are mesh-stable by
                      # construction
-                     "pad_axis_multiple", "per_shard", "pad_node_axis"}
+                     "pad_axis_multiple", "per_shard", "pad_node_axis",
+                     # the replica's row-index pad (ops/replica.py):
+                     # wraps _bucket over the dirty-row count, repeating
+                     # rows[0] — every index vector it returns is
+                     # ladder-shaped by construction
+                     "bucket_pad_rows"}
     PAD_FUNCS = {"_pad_axis"}
     SPEC_CTORS = {"SolveSpec", "EvictSpec", "ExpressSpec"}
     KERNEL_ENTRIES = {"solve_allocate", "solve_rounds", "solve_rounds_packed",
@@ -215,7 +223,10 @@ class BucketShape(Rule):
                       # fused session stages: their `sizes` tuples are
                       # jit-static exactly like spec fields
                       "_fuse_alloc", "_fuse_backfill", "_fuse_preempt",
-                      "_fuse_reclaim"}
+                      "_fuse_reclaim",
+                      # the replica/express shared row-scatter: its index
+                      # operand's length is a compiled-program shape
+                      "scatter_rows"}
     ALLOC_FUNCS = {"zeros", "ones", "empty", "full"}
     # window-size sinks: arg 1 (or k=) is a static shape in the compiled
     # program — an unbucketed k is a per-churn retrace
@@ -1081,7 +1092,12 @@ class MutationInvalidation(Rule):
                 # map memoizes its stats on stats_gen — a mutation that
                 # skips the bump serves stale lag/demotion accounting
                 "*/store/flowcontrol.py", "*/store/gateway.py",
-                "*/admission/intake.py")
+                "*/admission/intake.py",
+                # the device replica (ROADMAP item 2 landed): the
+                # commit fork's device half — every scatter/rebuild/
+                # adoption must bump replica_epoch or the whole-encode
+                # memo and the speculation seal go silently stale
+                "*/ops/replica.py")
 
     def check(self, tree, src, path):
         findings: List[Finding] = []
@@ -1306,7 +1322,12 @@ class FingerprintCompleteness(Rule):
     id = "VT009"
     title = "invalidation channel not sealed in the speculation fingerprint"
     patterns = ("*/scheduler/cache/*.py", "*/express/*.py",
-                "*/pipeline/*.py")
+                "*/pipeline/*.py",
+                # the device replica's epoch channel must be a sealed
+                # fingerprint component: a scatter between seal and apply
+                # means the staged buffers a speculation dispatched
+                # against were superseded
+                "*/ops/replica.py")
 
     FINGERPRINT_FUNCS = ("pipeline_fingerprint", "_fingerprint",
                          "mesh_fingerprint")

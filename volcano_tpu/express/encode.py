@@ -68,9 +68,13 @@ class ExpressState:
         self._index: Dict[str, int] = {}
         self._seen_generation = -1
         self.dev: Optional[dict] = None
+        # host twin of the staged column values (ops/replica.py mirror
+        # idiom): a marked row whose visible columns did not actually move
+        # is dropped before the scatter
+        self._mirror: Optional[dict] = None
         self.n = 0
         self.stats = {"rebuilds": 0, "row_patches": 0, "patched_rows": 0,
-                      "h2d_puts": 0}
+                      "h2d_puts": 0, "rows_deduped": 0}
 
     def detach(self) -> None:
         self.cache.snap_keeper.drop_shadow(self.shadow)
@@ -156,57 +160,44 @@ class ExpressState:
 
     def stage(self, rows: list) -> dict:
         """Device twins of the axis columns: wholesale put on rebuild,
-        dirty-row scatter otherwise. Returns the device buffer dict."""
+        dirty-row scatter otherwise. Returns the device buffer dict.
+
+        The scatter is the session replica's shared bucketed kernel
+        (ops/replica.scatter_rows) — one row-patch program family for the
+        whole codebase — and the lane keeps a host mirror of the staged
+        values, so a marked row whose columns did not actually move (the
+        bulk-apply echo of a placement the lane itself committed and
+        already patched, a status-only generation bump) is dropped before
+        it re-crosses the link: no more re-patching rows whose staged
+        values the last session already landed."""
         import jax
 
-        from volcano_tpu.ops.solver import _bucket
+        from volcano_tpu.ops import replica as replica_mod
 
+        cols = ("idle", "alloc", "cnt", "ok", "maxt")
         if self.dev is None:
-            idle, alloc, cnt, ok, maxt = self._host_cols()
-            self.dev = {
-                "idle": jax.device_put(idle),
-                "alloc": jax.device_put(alloc),
-                "cnt": jax.device_put(cnt),
-                "ok": jax.device_put(ok),
-                "maxt": jax.device_put(maxt),
-            }
+            self._mirror = dict(zip(cols, self._host_cols()))
+            self.dev = {k: jax.device_put(v)
+                        for k, v in self._mirror.items()}
             self.stats["h2d_puts"] += len(self.dev)
             return self.dev
         if rows:
-            db = _bucket(max(len(rows), 1))
-            # padding repeats the first dirty row — duplicate scatter
-            # writes of identical values, benign exactly as in
-            # rounds._rescore_dirty
-            padded = [rows[0]] * (db - len(rows)) + list(rows)
-            idx = np.asarray(padded, np.int32)
-            idle, alloc, cnt, ok, maxt = self._host_cols(padded)
-            self.dev = dict(zip(
-                ("idle", "alloc", "cnt", "ok", "maxt"),
-                _patch_rows(self.dev["idle"], self.dev["alloc"],
-                            self.dev["cnt"], self.dev["ok"],
-                            self.dev["maxt"], idx,
-                            idle, alloc, cnt, ok, maxt)))
+            sel = np.asarray(rows, np.int32)
+            vals = dict(zip(cols, self._host_cols(sel)))
+            keep = None
+            for k, v in vals.items():
+                d = v != self._mirror[k][sel]
+                if d.ndim > 1:
+                    d = d.any(axis=1)
+                keep = d if keep is None else (keep | d)
+            live = [r for r, kp in zip(rows, keep) if kp]
+            self.stats["rows_deduped"] += len(rows) - len(live)
+            if not live:
+                return self.dev
+            idx = replica_mod.bucket_pad_rows(live)
+            pvals = dict(zip(cols, self._host_cols(idx)))
+            self.dev = replica_mod.scatter_rows(self.dev, idx, pvals)
+            for k in cols:
+                self._mirror[k][idx] = pvals[k]
             self.stats["h2d_puts"] += 6  # idx + five row blocks
         return self.dev
-
-
-def _patch_rows(idle, alloc, cnt, ok, maxt, idx,
-                idle_r, alloc_r, cnt_r, ok_r, maxt_r):
-    """Scatter dirty rows into the device-resident columns. Jitted lazily
-    (import-time jax dependence would break jax-free hosts)."""
-    global _patch_rows_jit
-    if _patch_rows_jit is None:
-        import jax
-
-        def patch(idle, alloc, cnt, ok, maxt, idx,
-                  idle_r, alloc_r, cnt_r, ok_r, maxt_r):
-            return (idle.at[idx].set(idle_r), alloc.at[idx].set(alloc_r),
-                    cnt.at[idx].set(cnt_r), ok.at[idx].set(ok_r),
-                    maxt.at[idx].set(maxt_r))
-
-        _patch_rows_jit = jax.jit(patch)
-    return _patch_rows_jit(idle, alloc, cnt, ok, maxt, idx,
-                           idle_r, alloc_r, cnt_r, ok_r, maxt_r)
-
-
-_patch_rows_jit = None

@@ -534,6 +534,10 @@ def _run_fused(ssn, chain, action_ms, prep, plan, bf, t_chain) -> None:
             bf.spec, blayout, bstaged, bml, bmstaged, carry)
     packed_p, carry = _fuse_preempt(
         plan.spec, elayout, estaged, carry, sizes_p)
+    # the adoption candidate is taken BEFORE any further donation: a
+    # reclaim stage consumes the carry (donate_argnums), so only a
+    # preempt-terminal chain has a live full-state carry left to adopt
+    adopt_carry = None if "reclaim" in chain else carry
     if "reclaim" in chain:
         packed_r = _fuse_reclaim(
             plan.reclaim_spec, elayout, estaged, carry, sizes_r,
@@ -604,3 +608,30 @@ def _run_fused(ssn, chain, action_ms, prep, plan, bf, t_chain) -> None:
         if not ok:
             get_action("reclaim").execute(ssn)
         action_ms["reclaim"] = round((time.perf_counter() - t0) * 1e3, 3)
+    elif ok and adopt_carry is not None:
+        # the chain ended at preempt, so its final carry was NOT donated
+        # into a further stage: the post-chain node used/cnt it holds ARE
+        # the cluster's next accounting state on device — hand them to the
+        # standing replica instead of discarding them (ops/replica.py
+        # adoption: the next serve skips re-scattering rows only this
+        # chain's own placements changed)
+        _offer_carry(ssn, prep, plan, adopt_carry)
+
+
+def _offer_carry(ssn, prep, plan, carry) -> None:
+    """Adopt a fused chain's final full-state carry into the device
+    replica, when the evict node layout coincides with the rounds layout
+    (same names, same order, same padded extent — the adopt() shape gate
+    re-checks the extent); anything else is silently kept on the scatter
+    path, which is always correct."""
+    from volcano_tpu.ops import replica as replica_mod
+
+    rep = replica_mod.get(getattr(ssn, "cache", None), create=False) \
+        if getattr(ssn, "cache", None) is not None else None
+    if rep is None:
+        return
+    enc = prep["enc"]
+    names = list(plan.node_names)
+    if names != list(enc.node_names)[:len(names)]:
+        return
+    rep.adopt({"node_used": carry["used"], "node_cnt": carry["cnt"]})

@@ -396,6 +396,29 @@ class BatchAllocator:
                 self.profile["fallback"] = (
                     f"rounds apply cannot honor custom plugins: {sorted(unknown)}")
                 return None
+        # whole-encode reuse (ops/replica.py): when NOTHING the encode
+        # reads has moved since the last prepare — the cache's pipeline
+        # fingerprint, the tiers identity, the round-robin cursor, mesh
+        # and mode — the previous session's entire prepare bundle (enc +
+        # spec + layout + staged device buffers) is still exact. This is
+        # the steady-state fast path: prepare degenerates to the
+        # fingerprint probe, encode_s ~ 0 with zero transfers.
+        from volcano_tpu.ops import replica as replica_mod
+
+        rep = replica_mod.get(getattr(ssn, "cache", None)) \
+            if getattr(ssn, "cache", None) is not None else None
+        token = None
+        if rep is not None:
+            token = rep.encode_token(ssn, self.mesh, self.mode)
+            prev = rep.serve_prepare(token)
+            if prev is not None:
+                prev["t0"] = t0
+                prev["t1"] = time.perf_counter()
+                self.profile["encode_reused"] = True
+                self.profile["h2d_puts"] = 0
+                self.profile["h2d_cached"] = 0
+                self.profile["replica_epoch"] = rep.replica_epoch
+                return prev
         try:
             # rounds mode tolerates un-modeled constructs as a serial
             # residue (affinity/port tasks stay PENDING; releasing capacity
@@ -478,27 +501,50 @@ class BatchAllocator:
                 # each — in parallel across the devices — and the merged
                 # dict feeds the SAME solve_rounds_packed entry (plain
                 # keys folded back in by rounds.unpack_layout)
+                # the state-dependent accounting arrays leave the pack and
+                # ride the standing device replica (ops/replica.py):
+                # committed deltas since the last session become bucketed
+                # row scatters against the persistent buffers instead of a
+                # host re-pack + device_put, and unpack_layout folds the
+                # plain-keyed replica buffers back in beside the packed
+                # groups exactly like the mesh path's sharded node arrays
+                rep_part = {}
+                if rep is not None:
+                    rep_part = {k: v for k, v in rounds_arrays.items()
+                                if k in replica_mod.SERVED}
                 if self.mesh is None:
-                    layout, bufs = _pack(rounds_arrays)
+                    rest = {k: v for k, v in rounds_arrays.items()
+                            if k not in rep_part}
+                    layout, bufs = _pack(rest)
                     t2 = time.perf_counter()
                     staged = _stage(bufs, self.profile)
                 else:
                     from volcano_tpu.ops import shard as shard_mod
 
                     node_part = {k: rounds_arrays[k] for k in _NODE_AXIS
-                                 if k in rounds_arrays}
+                                 if k in rounds_arrays and k not in rep_part}
                     rest = {k: v for k, v in rounds_arrays.items()
-                            if k not in node_part}
+                            if k not in node_part and k not in rep_part}
                     layout, bufs = _pack(rest)
                     t2 = time.perf_counter()
                     staged = _stage(bufs, self.profile, mesh=self.mesh)
                     staged.update(shard_mod.stage_node_arrays(
                         node_part, _NODE_AXIS, self.mesh, self.profile))
                     self.profile["mesh_devices"] = node_multiple
+                if rep_part:
+                    staged.update(rep.serve(
+                        rep_part, ssn, enc, self.mesh, self.profile))
                 prep["layout"] = layout
                 prep["staged"] = staged
                 prep["pack_s"] = t2 - t1
                 prep["h2d_s"] = time.perf_counter() - t2
+                if rep is not None:
+                    # token recomputed AFTER the serve: the serve bumps the
+                    # replica epoch (a fingerprint component), and the
+                    # stored token must describe the state this bundle was
+                    # built against so an unchanged next session hits
+                    rep.store_prepare(
+                        rep.encode_token(ssn, self.mesh, self.mode), prep)
         except Exception as e:  # any device/compile failure -> serial oracle
             logger.exception("tpuscore prepare failed; falling back to serial")
             self.profile["fallback"] = f"solve error: {e}"

@@ -1124,8 +1124,13 @@ class SchedulerCache:
         move neither dirty epoch nor acct and a sealed stage could commit
         against state it never saw; surfaced by vclint VT009). Any
         component moving between seal and check means state the
-        speculative snapshot did not see — the stage is discarded."""
+        speculative snapshot did not see — the stage is discarded. The
+        device replica's epoch (ops/replica.py) rides along: a sealed
+        stage captured its staged buffers from a specific replica state,
+        and a scatter/rebuild/adoption between seal and check means the
+        device content it dispatched against has been superseded."""
         keeper = self.snap_keeper
+        rep = getattr(self, "_device_replica", None)
         with self._lock:
             acct = 0
             for node in self.nodes.values():
@@ -1135,4 +1140,5 @@ class SchedulerCache:
                 jver += job._status_version
             return (keeper.dirty_epoch, keeper.generation,
                     self.fence_epoch, acct, len(self.nodes),
-                    jver, len(self.jobs))
+                    jver, len(self.jobs),
+                    rep.replica_epoch if rep is not None else -1)

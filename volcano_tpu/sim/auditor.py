@@ -151,6 +151,7 @@ class Auditor:
         found.extend(self._check_express())
         found.extend(self._check_pipeline())
         found.extend(self._check_front_door(session))
+        found.extend(self._check_replica())
         found.extend(self._check_fallback_budgets())
         if getattr(self.sim, "ha_enabled", False):
             found.extend(self._check_ha_fencing())
@@ -507,12 +508,75 @@ class Auditor:
                             {k: v[:20] for k, v in diff.items()}))
         return out
 
+    def _check_replica(self) -> List[Violation]:
+        """Device-replica coherence (PR 13): the standing device copy of
+        cluster state must never claim to be AHEAD of the keeper it
+        shadows, its host mirror and device buffers must stay
+        structurally twinned (same names, same shapes — a divergence
+        means a scatter landed on one side only), and witness mode must
+        have explained every patched row (a witness violation is device
+        state moving without a keeper-marked cause). Silent when the
+        replica is disabled or the cache has never served one."""
+        from volcano_tpu.ops import replica as replica_mod
+
+        out: List[Violation] = []
+        rep = replica_mod.get(self.sim.cache, create=False)
+        if rep is not None:
+            keeper = self.sim.cache.snap_keeper
+            if (rep._generation is not None
+                    and rep._generation > keeper.generation):
+                out.append(Violation(
+                    "replica_coherence", "generation-ahead",
+                    f"replica recorded keeper generation "
+                    f"{rep._generation} but the keeper is at "
+                    f"{keeper.generation} — the replica validated "
+                    f"against state that does not exist yet",
+                    {"replica_generation": rep._generation,
+                     "keeper_generation": keeper.generation}))
+            if set(rep.mirror) != set(rep.dev):
+                out.append(Violation(
+                    "replica_coherence", "mirror-dev-names",
+                    "host mirror and device buffers hold different "
+                    "array sets — a put landed on one side only",
+                    {"mirror_only": sorted(set(rep.mirror)
+                                           - set(rep.dev)),
+                     "dev_only": sorted(set(rep.dev)
+                                        - set(rep.mirror))}))
+            else:
+                for name in rep.mirror:
+                    if (tuple(rep.mirror[name].shape)
+                            != tuple(rep.dev[name].shape)):
+                        out.append(Violation(
+                            "replica_coherence", f"shape:{name}",
+                            f"mirror/device shape divergence on "
+                            f"{name}: {rep.mirror[name].shape} vs "
+                            f"{rep.dev[name].shape}",
+                            {"name": name,
+                             "mirror": list(rep.mirror[name].shape),
+                             "dev": list(rep.dev[name].shape)}))
+        witnessed = self.sim.replica_stats_combined().get(
+            "witness_violations", 0)
+        flagged = getattr(self, "_replica_witness_flagged", 0)
+        if witnessed > flagged:
+            out.append(Violation(
+                "replica_coherence", "witness",
+                f"{witnessed - flagged} new replica witness "
+                f"violation(s): device rows moved without a "
+                f"keeper-marked cause (delta path integrity broke; "
+                f"the serve healed by wholesale rebuild but the "
+                f"unexplained mutation is a real bug)",
+                {"witness_violations": witnessed}))
+            self._replica_witness_flagged = witnessed
+        return out
+
     def _check_fallback_budgets(self) -> List[Violation]:
         """Envelope budgets (ROADMAP item 4): the scenario's
         ``audit.budgets`` pins a maximum rate per fallback family —
         ``fuse_fallback_rate`` / ``evict_fallback_rate`` (per session),
         ``express_deferral_rate`` (per arrival),
-        ``pipeline_spec_discard_rate`` (per dispatch). A rate above its
+        ``pipeline_spec_discard_rate`` (per dispatch),
+        ``replica_rebuild_rate`` (cold-excluded wholesale restages per
+        replica serve). A rate above its
         budget is a gate failure exactly like a parity violation: the
         honesty fallbacks are a tax on real traffic, and this is the
         standing meter that keeps them a rounding error. Each entry is a
@@ -533,6 +597,7 @@ class Auditor:
                 "pipeline_spec_dispatched", 0),
             "admission_shed_rate": rates.get("admission_attempts", 0),
             "watch_coalesce_rate": rates.get("watch_events_handled", 0),
+            "replica_rebuild_rate": rates.get("replica_serves", 0),
         }
         for name in sorted(budgets):
             spec = budgets[name]

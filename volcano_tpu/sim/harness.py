@@ -155,6 +155,10 @@ class SimCluster:
         # generations (restarts/takeovers) for the auditor
         self.pipeline_driver = None
         self._pipeline_stats_total: Dict = {}
+        # device-replica accounting folds the same way: every cache
+        # generation (restarts, deposed leaders) banks its replica stats
+        # here so the auditor's rebuild-rate budget sees the whole run
+        self._replica_stats_total: Dict = {}
         # -- HA failover state (cfg["ha"]["enabled"]): a fenced active
         # leader plus a warm standby cache following the same store; chaos
         # deposes the leader (mid-defer / mid-chain / mid-express) and the
@@ -359,10 +363,33 @@ class SimCluster:
             self._fold_stats(total, drv.stats)
         return total
 
+    def _fold_replica_stats(self, cache) -> None:
+        """Bank a retiring cache generation's device-replica accounting
+        (its replica dies with the process analog; the run-wide totals
+        feed the auditor's rebuild-rate budget)."""
+        from volcano_tpu.ops import replica as replica_mod
+
+        rep = replica_mod.get(cache, create=False)
+        if rep is not None:
+            self._fold_stats(self._replica_stats_total, rep.stats)
+
+    def replica_stats_combined(self) -> Dict:
+        """Run-wide device-replica accounting: retired cache generations
+        plus the live one (serves/scatters/rebuilds/witness counters)."""
+        from volcano_tpu.ops import replica as replica_mod
+
+        total: Dict = {}
+        self._fold_stats(total, self._replica_stats_total)
+        rep = replica_mod.get(self.cache, create=False)
+        if rep is not None:
+            self._fold_stats(total, rep.stats)
+        return total
+
     def restart_scheduler(self, why: str) -> None:
         """Crash-recover the scheduler: drop the cache (incl. any deferred
         mirror work — the store is the only durable truth) and rebuild it
         from a fresh list+watch replay."""
+        self._fold_replica_stats(self.cache)
         self.cache.detach_watches()
         self._build_scheduler()
         self.restarts["scheduler"] += 1
@@ -482,6 +509,7 @@ class SimCluster:
         following."""
         self._pending_promote = False
         old = self.cache
+        self._fold_replica_stats(old)
         old.detach_watches()
         self.cache = self._standby_cache
         self.cache.set_fence_epoch(self.leader_epoch)
@@ -861,6 +889,23 @@ class SimCluster:
             out["pipeline_spec_discards"] = stats.get("spec_discarded", 0)
             out["pipeline_spec_discard_rate"] = round(
                 stats.get("spec_discarded", 0) / max(dispatched, 1), 4)
+        rep_stats = self.replica_stats_combined()
+        if rep_stats.get("serves"):
+            # device-replica envelope: wholesale restages per serve.
+            # Excluded: "cold" (every fresh cache generation's first serve
+            # is definitionally cold — restarts are chaos's doing) and
+            # "dense:<family>" (a per-family dense re-put INSIDE a delta
+            # serve — the honest path when churn exceeds the patch
+            # fraction, and tiny axes like the 1-row queue family take it
+            # every time by design)
+            serves = rep_stats["serves"]
+            rebuilds = sum(n for reason, n
+                           in rep_stats.get("rebuilds", {}).items()
+                           if reason != "cold"
+                           and not reason.startswith("dense:"))
+            out["replica_serves"] = serves
+            out["replica_rebuilds"] = rebuilds
+            out["replica_rebuild_rate"] = round(rebuilds / serves, 4)
         if self.front_door_gate is not None:
             st = self.front_door_gate.stats()
             out["admission_attempts"] = int(st["attempts"])
@@ -983,6 +1028,7 @@ class SimCluster:
             "pipeline": (self.pipeline_stats_combined()
                          if (self.pipeline_driver is not None
                              or self._pipeline_stats_total) else None),
+            "replica": (self.replica_stats_combined() or None),
             "express": ({
                 **{k: v for k, v in
                    self.express_lane.counters.items()},

@@ -62,7 +62,8 @@ class _Collector(object):
         global _active
         self._prev = _active
         _active = {"sync_points": 0, "d2h_fetches": 0, "overlap_s": 0.0,
-                   "fence_wait_s": 0.0}
+                   "fence_wait_s": 0.0, "overlappable_dispatches": 0,
+                   "overlappable_rows": 0}
         return _active
 
     def __exit__(self, *exc) -> None:
@@ -75,6 +76,10 @@ class _Collector(object):
                 counters["overlap_s"] * 1e3, 3)
             self.profile["tpu_fence_wait_ms"] = round(
                 counters["fence_wait_s"] * 1e3, 3)
+            self.profile["tpu_overlappable_dispatches"] = \
+                counters["overlappable_dispatches"]
+            self.profile["tpu_overlappable_rows"] = \
+                counters["overlappable_rows"]
 
 
 def session(profile: dict) -> _Collector:
@@ -117,6 +122,18 @@ def start_fetch(x) -> Callable[[], np.ndarray]:
         return out
 
     return wait
+
+
+def note_overlappable(rows: int = 0) -> None:
+    """Count an async device dispatch whose result is never fetched or
+    fenced by its issuer — the replica's row scatters (ops/replica.py):
+    the scatter enqueues, the session's host work continues, and the
+    buffers are consumed device-side by the next solve. These are the
+    opposite of sync points — item 1's floor attribution subtracts them
+    from the h2d traffic a real-TPU session would have to hide."""
+    if _active is not None:
+        _active["overlappable_dispatches"] += 1
+        _active["overlappable_rows"] += int(rows)
 
 
 def register(x) -> None:

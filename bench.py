@@ -778,6 +778,8 @@ def run_pipeline(scale: float, cycles: int = 24, warm: int = 4,
     pipelined = _arm(pipelined=True)
     speedup = (pipelined["sessions_per_sec"] / serial["sessions_per_sec"]
                if serial["sessions_per_sec"] else 0.0)
+    churn = _pipeline_churn(scale, batches, actions, args, seed,
+                            warm=warm)
     return {
         "scale": scale,
         "arrival_rate_per_cycle": rate_per_cycle,
@@ -786,6 +788,189 @@ def run_pipeline(scale: float, cycles: int = 24, warm: int = 4,
         "pipeline_sessions_per_sec": pipelined["sessions_per_sec"],
         "p99_submit_bind_ms": pipelined["p99_task_wait_ms"],
         "speedup_sessions_per_sec": round(speedup, 3),
+        "churn": churn,
+        "pipeline_spec_commit_rate": churn["commit_rate_readset"],
+    }
+
+
+def _pipeline_churn(scale, batches, actions, args, seed,
+                    queue_rate_per_cycle: float = 3.0,
+                    node_rate_per_cycle: float = 0.35, warm: int = 4):
+    """The --pipeline churn arm (PR 15): replay run_pipeline's exact
+    arrival schedule with a pregenerated Poisson mix of value-neutral
+    deltas injected BETWEEN each speculation's seal and its apply —
+    spec echoes on bystander queues no sealed solve ever consumed (the
+    other-tenant watch-noise family, the dominant steady-state delta in
+    a shared cluster), salted with node status echoes. Three arms on
+    identical inputs:
+
+      serial    — the byte-for-byte oracle (echoes are placement no-ops);
+      whole_fp  — pipelined with VOLCANO_TPU_READSET=0: every echoed
+                  window moves the coarse fingerprint, so the sealed
+                  solve is discarded on ANY movement (~0 commit rate —
+                  the pre-PR-15 behavior this arm keeps measurable);
+      readset   — pipelined with the read-set seal: bystander-queue
+                  noise is provably disjoint from the sealed read set,
+                  so those windows COMMIT; the node-echo salt shows the
+                  conservative direction in the same run (cfg5's
+                  homogeneous node scores leave the windowed solve no
+                  provable coverage, so its touched mask is full-width
+                  and a node echo honestly discards — the partial-mask
+                  commit case is pinned by tests/test_continuous_pipeline
+                  on a window-exact regime).
+
+    The acceptance triplet: readset commit rate >= 0.5 under churn where
+    whole_fp sits at ~0, binds byte-identical across all three arms, and
+    zero warm compiles in the readset arm's measured window (the echo
+    stream must never perturb bucket shapes)."""
+    import copy as _copy
+    import os as _os
+    import random
+
+    import volcano_tpu.scheduler.actions  # noqa: F401 (register actions)
+    from volcano_tpu.api import objects
+    from volcano_tpu.bench.clusters import (
+        DEFAULT_TIERS, build_config, make_tiers)
+    from volcano_tpu.scheduler.util.test_utils import (
+        build_pod, build_pod_group, build_queue)
+    from volcano_tpu.utils import devprof
+
+    total = len(batches)
+    n_bystanders = 8
+    rng = random.Random(seed * 7919)
+
+    def _poisson_burst(rate):
+        n, budget = 0, 1.0
+        while True:
+            gap = rng.expovariate(rate)
+            if gap > budget:
+                return n
+            budget -= gap
+            n += 1
+
+    echoes = []
+    for _ in range(total):
+        burst = [("queue", rng.random())
+                 for _ in range(max(_poisson_burst(queue_rate_per_cycle), 1))]
+        burst += [("node", rng.random())
+                  for _ in range(_poisson_burst(node_rate_per_cycle))]
+        # at least one echo per window: every speculation faces a delta,
+        # so a commit can never be the degenerate quiet-window kind
+        echoes.append(burst)
+
+    def _inject_jobs(cache, batch):
+        for name, tasks, cpu in batch:
+            cache.add_pod_group(build_pod_group(
+                name, namespace="arr", min_member=tasks))
+            for t in range(tasks):
+                cache.add_pod(build_pod(
+                    "arr", f"{name}-t{t}", "", objects.POD_PHASE_PENDING,
+                    {"cpu": f"{cpu}m", "memory": "256Mi"}, name))
+
+    def _arm(mode):
+        from volcano_tpu.scheduler.framework import (
+            close_session, open_session, run_actions)
+
+        prev = _os.environ.get("VOLCANO_TPU_READSET")
+        if mode == "whole_fp":
+            _os.environ["VOLCANO_TPU_READSET"] = "0"
+        try:
+            cache, _, _, _, _ = build_config(5, scale)
+            tiers = make_tiers(["tpuscore"], *DEFAULT_TIERS,
+                               arguments=args)
+            node_names = sorted(cache.nodes)
+            # bystander queues exist BEFORE the first session: later
+            # re-adds are spec echoes on an existing queue (the scoped
+            # mark), never a queue-SET change (wholesale invalidation)
+            bystanders = [build_queue(f"bystander-{i}", weight=1)
+                          for i in range(n_bystanders)]
+            for q in bystanders:
+                cache.add_queue(q)
+            pending = list(batches)
+            drv = None
+            if mode != "serial":
+                from volcano_tpu.pipeline import PipelineDriver
+
+                def intake():
+                    if pending:
+                        _inject_jobs(cache, pending.pop(0))
+
+                drv = PipelineDriver(
+                    cache, lambda: (actions, tiers), intake=intake)
+                _inject_jobs(cache, pending.pop(0))
+            try:
+                from volcano_tpu.utils.jaxcompile import CompileWatcher
+
+                watcher = CompileWatcher.install()
+            except Exception:
+                watcher = None
+            win = None
+            for k in range(total):
+                if k == warm:
+                    devprof.drain()
+                    if watcher is not None:
+                        win = watcher.window()
+                if drv is not None:
+                    drv.run_cycle()
+                else:
+                    _inject_jobs(cache, pending.pop(0))
+                    ssn = open_session(cache, tiers)
+                    try:
+                        run_actions(ssn, actions)
+                    finally:
+                        close_session(ssn)
+                # the echo stream lands AFTER this cycle sealed the next
+                # solve-ahead — between seal and apply, the window the
+                # whole-fingerprint seal can never survive
+                for fam, frac in echoes[k]:
+                    if fam == "queue":
+                        cache.add_queue(_copy.deepcopy(
+                            bystanders[int(frac * n_bystanders)
+                                       % n_bystanders]))
+                    else:
+                        name = node_names[int(frac * len(node_names))
+                                          % len(node_names)]
+                        cache.add_node(
+                            _copy.deepcopy(cache.nodes[name].node))
+            devprof.drain()
+            if drv is not None:
+                drv.abandon()
+            out = {
+                "binds": dict(cache.binder.binds),
+                "warm_compiles":
+                    win.delta().compiles if win is not None else None,
+            }
+            if drv is not None:
+                st = drv.stats
+                out["spec_dispatched"] = st["spec_dispatched"]
+                out["spec_applied"] = st["spec_applied"]
+                out["spec_commits"] = dict(st["spec_commits"])
+                out["spec_discards"] = dict(st["spec_discards"])
+                out["commit_rate"] = round(
+                    st["spec_applied"] / max(st["spec_dispatched"], 1), 4)
+            return out
+        finally:
+            if prev is None:
+                _os.environ.pop("VOLCANO_TPU_READSET", None)
+            else:
+                _os.environ["VOLCANO_TPU_READSET"] = prev
+
+    serial = _arm("serial")
+    whole = _arm("whole_fp")
+    scoped = _arm("readset")
+    return {
+        "queue_echo_rate_per_cycle": queue_rate_per_cycle,
+        "node_echo_rate_per_cycle": node_rate_per_cycle,
+        "echo_deltas_total": sum(len(e) for e in echoes),
+        "commit_rate_readset": scoped["commit_rate"],
+        "commit_rate_whole_fingerprint": whole["commit_rate"],
+        "spec_commits": scoped["spec_commits"],
+        "spec_discards": scoped["spec_discards"],
+        "whole_fp_discards": whole["spec_discards"],
+        "binds_match_serial": scoped["binds"] == serial["binds"],
+        "whole_fp_binds_match_serial": whole["binds"] == serial["binds"],
+        "binds": len(serial["binds"]),
+        "warm_compiles_readset": scoped["warm_compiles"],
     }
 
 
@@ -1244,6 +1429,12 @@ def main() -> int:
                         "spec_discarded", 0)
                     / max(result["pipeline"].get("driver", {}).get(
                         "spec_dispatched", 0), 1), 4),
+                # the churn arm's standing column (PR 15): the read-set
+                # seal committing the solve-ahead through echo churn the
+                # whole-fingerprint seal discards wholesale
+                "pipeline_spec_commit_rate":
+                    result["pipeline_spec_commit_rate"],
+                "churn": result["churn"],
             },
             "pipeline_full": result,
         }}, separators=(",", ":"), default=str), flush=True)

@@ -15,6 +15,17 @@ class SealedCacheFingerprint:
         return (self.keeper.local_epoch, self.keeper.local_gen)
 
 
+class ScopedIntersect:
+    """PR 15 read-set scope, clean: the intersect consumes only channels
+    the fingerprint below already seals (cursor exactness over
+    local_epoch), so scoping can never outrun the seal."""
+
+    def marks_since(self, cursor):
+        if self.journal_base + len(self.journal) != self.local_epoch:
+            return None
+        return self.journal[cursor - self.journal_base:]
+
+
 class DerivedMemo:
     def refresh(self):
         # a REAL unsealed-channel finding silenced only by the justified

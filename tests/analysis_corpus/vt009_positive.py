@@ -29,3 +29,25 @@ class LeakyReplica:
 
     def scatter(self):
         self.buffer_seq += 1  # vclint-expect: VT009
+
+
+class LeakyIntersect:
+    """PR 15 read-set scope: the seal/intersect path consumes a channel
+    the fingerprint never seals — movement on it alone can never trigger
+    the re-check, so a sealed stage commits as a quiet window."""
+
+    def marks_since(self, cursor):
+        if cursor < self.policy_epoch:  # vclint-expect: VT009
+            return None
+        return self.journal[cursor:]
+
+
+class LeakyDriverCheck:
+    """Same hole one call deep: the consumer closure must follow the
+    intersect into its helpers."""
+
+    def _readset_check(self, st):
+        return self._delta_ok(st)
+
+    def _delta_ok(self, st):
+        return st.cursor == self.mesh_gen  # vclint-expect: VT009

@@ -127,8 +127,8 @@ def _encode(populate, tiers):
 def _solve(spec, arrays):
     from volcano_tpu.ops import rounds as R
 
-    assign, n_rounds, tail_placed, full_sweeps, capped, hist = R.solve_rounds(
-        spec, arrays)
+    (assign, n_rounds, tail_placed, full_sweeps, capped, hist,
+     _touched) = R.solve_rounds(spec, arrays)
     return (np.asarray(assign), int(n_rounds), int(tail_placed),
             int(full_sweeps), bool(capped), np.asarray(hist))
 

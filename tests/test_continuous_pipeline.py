@@ -11,6 +11,7 @@ the behavioral one), and the stale-at-apply re-check never fires.
 
 from __future__ import annotations
 
+import os
 import random
 
 import pytest
@@ -190,8 +191,10 @@ def test_pipeline_parity_wide(seed):
 def test_speculation_commits_on_quiet_cycles():
     """Delta-free cycles are the speculation windows: with a standing
     backlog and nothing moving between seal and apply, the solve-ahead
-    must actually commit (spec_applied > 0) — and a trace with watch
-    deltas must discard at least once with the watch_delta reason."""
+    must actually commit (spec_applied > 0, kind="quiet") — and a NEW
+    gang landing on sealed state must still discard: membership growth
+    is work the serial order would have admitted into the sealed cycle,
+    so the read-set scope calls it a phantom row."""
     state = _cluster(5)
     cache = state["cache"]
     tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
@@ -199,7 +202,172 @@ def test_speculation_commits_on_quiet_cycles():
     for _ in range(4):  # quiet back-to-back cycles
         drv.run_cycle()
     assert drv.stats["spec_applied"] >= 1, drv.stats
+    assert drv.stats["spec_commits"].get("quiet", 0) >= 1, drv.stats
     _add_gang(state)  # a watch delta lands on sealed state
+    drv.run_cycle()
+    assert drv.stats["spec_discards"].get("readset:phantom", 0) >= 1, \
+        drv.stats
+    drv.abandon()
+    _check_accounting(drv.stats)
+
+
+# -- read-set-scoped speculation ---------------------------------------------
+
+
+_WIDE_N = 96
+
+
+def _wide_cluster(anchors=4):
+    """A node axis wide enough for WINDOWED rounds nomination (the
+    touched-node mask covers a strict subset of the axis), anchored by a
+    standing backlog of unplaceable gangs (8 cpu tasks vs 4 cpu nodes:
+    n_feas == 0, so the coverage bit stays exact and no full sweep
+    widens the mask) — every cycle re-runs the packed solve and every
+    speculation seals a partial node read set."""
+    cache = make_cache()
+    cache.add_queue(build_queue("default"))
+    state = {"cache": cache, "rng": random.Random(0), "pods": {}, "n": 0}
+    for n in range(_WIDE_N):
+        cache.add_node(build_node(
+            f"w{n:02d}", build_resource_list_with_pods("4", "12Gi",
+                                                       pods=64)))
+    for i in range(anchors):
+        pg = f"anchor-{i}"
+        cache.add_pod_group(build_pod_group(
+            pg, namespace="pl", min_member=1,
+            phase=objects.PodGroupPhase.PENDING))
+        for t in range(2):
+            pod = build_pod(
+                "pl", f"{pg}-t{t}", "", objects.POD_PHASE_PENDING,
+                {"cpu": "8000m", "memory": "1Gi"}, pg)
+            cache.add_pod(pod)
+            state["pods"][f"pl/{pg}-t{t}"] = pod
+    return state
+
+
+def _echo_node(cache, name):
+    """A value-neutral node status echo (the kubelet's periodic resync):
+    same name, same capacity — marks the keeper, moves the coarse
+    fingerprint, changes nothing the solve could have read differently."""
+    cache.add_node(build_node(
+        name, build_resource_list_with_pods("4", "12Gi", pods=64)))
+
+
+def test_readset_echo_on_untouched_node_commits():
+    """Directed commit case: a status echo on a node OUTSIDE the sealed
+    stage's touched mask is provably disjoint — the stage must COMMIT
+    (kind="readset") with zero discards, and the disjointness witness
+    must record the delta/read split for the auditor."""
+    state = _wide_cluster()
+    cache = state["cache"]
+    tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
+    drv = _mk_driver(cache, tiers)
+    drv.run_cycle()
+    st = drv._inflight
+    assert st is not None and st.readset is not None
+    read = drv._read_node_set(st)
+    assert read is not None
+    untouched = sorted(set(cache.nodes) - read)
+    assert untouched, "window covered the whole axis; widen _WIDE_N"
+    _echo_node(cache, untouched[0])
+    drv.run_cycle()
+    assert drv.stats["spec_commits"].get("readset", 0) == 1, drv.stats
+    assert drv.stats["spec_discarded"] == 0, drv.stats
+    assert drv.stats["stale_commits"] == 0, drv.stats
+    audit = drv.readset_audit[-1]
+    assert audit["delta_nodes"] == [untouched[0]], audit
+    assert untouched[0] not in audit["read_nodes"], audit
+    drv.abandon()
+    _check_accounting(drv.stats)
+
+
+def test_readset_capacity_change_on_read_node_discards():
+    """Directed discard case: a CAPACITY change on a node the sealed
+    solve actually read intersects the read set — the stage must discard
+    with the readset:node family (and the serial re-run then sees the
+    new capacity: the anchors fit the grown node)."""
+    state = _wide_cluster()
+    cache = state["cache"]
+    tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
+    drv = _mk_driver(cache, tiers)
+    drv.run_cycle()
+    st = drv._inflight
+    assert st is not None and st.readset is not None
+    read = drv._read_node_set(st)
+    assert read, "empty node read set; the solve read nothing?"
+    target = sorted(read)[0]
+    cache.add_node(build_node(  # capacity grows 4 -> 16 cpu: a real delta
+        target, build_resource_list_with_pods("16", "48Gi", pods=64)))
+    drv.run_cycle()
+    assert drv.stats["spec_discards"].get("readset:node", 0) >= 1, \
+        drv.stats
+    assert drv.stats["spec_commits"].get("readset", 0) == 0, drv.stats
+    drv.abandon()
+    _check_accounting(drv.stats)
+
+
+def _drive_mixed(seed, readset_on, cycles=8):
+    """One arm of the read-set parity fuzz: node echoes + gang arrivals +
+    pod deletes over the wide cluster, with read-set scoping on or off.
+    The delta trace is a function of the seed alone."""
+    prev = os.environ.get("VOLCANO_TPU_READSET")
+    os.environ["VOLCANO_TPU_READSET"] = "1" if readset_on else "0"
+    try:
+        state = _wide_cluster()
+        state["rng"] = random.Random(seed)
+        trace_rng = random.Random(seed * 104729)
+        cache = state["cache"]
+        tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
+        drv = _mk_driver(cache, tiers)
+        kinds = ["none", "echo", "echo", "gang", "del", "echo"]
+        for _ in range(cycles):
+            kind = trace_rng.choice(kinds)
+            if kind == "echo":
+                _echo_node(cache, f"w{trace_rng.randrange(_WIDE_N):02d}")
+            else:
+                _apply_delta(state, kind)
+            drv.run_cycle()
+        drv.abandon()
+        cache.flush_mirror()
+        return _signature(cache), dict(drv.stats)
+    finally:
+        if prev is None:
+            os.environ.pop("VOLCANO_TPU_READSET", None)
+        else:
+            os.environ["VOLCANO_TPU_READSET"] = prev
+
+
+def test_readset_mixed_churn_parity_ten_seeds():
+    """The oracle contract under real churn, 10 seeds: for the SAME
+    echo/gang/delete trace, read-set scoping ON lands byte-for-byte the
+    end state scoping OFF lands (every commit it adds is of a stage the
+    old seal would merely have re-run on identical state) — and across
+    the seeds the on-arm actually commits through churn at least once
+    while the off-arm, by construction, never can."""
+    total_readset_commits = 0
+    for seed in range(60, 70):
+        got_on, stats_on = _drive_mixed(seed, True)
+        got_off, stats_off = _drive_mixed(seed, False)
+        assert got_on == got_off, (seed, stats_on, stats_off)
+        _check_accounting(stats_on)
+        _check_accounting(stats_off)
+        assert stats_off["spec_commits"].get("readset", 0) == 0, stats_off
+        total_readset_commits += stats_on["spec_commits"].get("readset", 0)
+    assert total_readset_commits >= 1
+
+
+def test_readset_off_restores_whole_fingerprint_scope(monkeypatch):
+    """VOLCANO_TPU_READSET=0: the same new-gang delta discards with the
+    coarse watch_delta attribution — the pre-read-set behavior, bit for
+    bit."""
+    monkeypatch.setenv("VOLCANO_TPU_READSET", "0")
+    state = _cluster(5)
+    cache = state["cache"]
+    tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
+    drv = _mk_driver(cache, tiers)
+    for _ in range(2):
+        drv.run_cycle()
+    _add_gang(state)
     drv.run_cycle()
     assert drv.stats["spec_discards"].get("watch_delta", 0) >= 1, drv.stats
     drv.abandon()
@@ -325,8 +493,9 @@ def test_mesh_change_discards_speculation():
 def test_policy_meta_delta_discards_speculation():
     """A queue spec update (weight change) between seal and apply has no
     per-object dirty mark — QueueInfos re-derive fresh each snapshot —
-    but the sealed solve read the OLD policy, so the keeper's meta epoch
-    must invalidate the stage."""
+    but the sealed solve read the OLD policy, so the keeper's scoped
+    queue mark must invalidate the stage — the sealed solve consumed
+    this queue's policy row, so the read-set scope intersects."""
     state = _cluster(19)
     cache = state["cache"]
     tiers = make_tiers(*TIERS_SPEC, arguments=ARGS)
@@ -335,7 +504,8 @@ def test_policy_meta_delta_discards_speculation():
     assert drv._inflight is not None
     cache.add_queue(build_queue("default", weight=7))  # spec update
     drv.run_cycle()
-    assert drv.stats["spec_discards"].get("watch_delta", 0) >= 1, drv.stats
+    assert drv.stats["spec_discards"].get("readset:queue", 0) >= 1, \
+        drv.stats
     drv.abandon()
     _check_accounting(drv.stats)
 

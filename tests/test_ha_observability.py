@@ -247,13 +247,16 @@ class TestObservabilityEndpoints:
 
     def test_metrics_pipeline_series(self):
         """Continuous-pipeline observability on /metrics: the sustained
-        sessions/sec gauge, the per-reason speculation-discard counter
-        (the never-applied proof surfaced to operators), and the overlap
+        sessions/sec gauge, the per-reason speculation-discard and
+        per-kind commit counters (the never-applied proof and the
+        read-set scope's earning surfaced to operators), and the overlap
         histogram with its mandatory le=\"+Inf\" bucket."""
         metrics.reset()
         metrics.set_pipeline_sessions_per_sec(12.5)
-        metrics.register_pipeline_spec_discard("watch_delta", 3)
+        metrics.register_pipeline_spec_discard("readset:node", 3)
         metrics.register_pipeline_spec_discard("express_commit")
+        metrics.register_pipeline_spec_commit("readset", 2)
+        metrics.register_pipeline_spec_commit("quiet")
         metrics.observe_pipeline_overlap(0.002)
         metrics.observe_pipeline_overlap(0.05)
         srv = ObservabilityServer(":0").start()
@@ -268,8 +271,14 @@ class TestObservabilityEndpoints:
         assert "volcano_pipeline_sessions_per_sec 12.5" in lines
         c = "volcano_pipeline_spec_discards_total"
         assert f"# TYPE {c} counter" in lines
-        assert f'{c}{{reason="watch_delta"}} 3.0' in lines
+        assert f'{c}{{reason="readset:node"}} 3.0' in lines
         assert f'{c}{{reason="express_commit"}} 1.0' in lines
+        # the commit side of the ledger (PR 15): per-kind applied stages
+        # — "readset" is the scoped seal committing THROUGH a delta
+        k = "volcano_pipeline_spec_commits_total"
+        assert f"# TYPE {k} counter" in lines
+        assert f'{k}{{kind="readset"}} 2.0' in lines
+        assert f'{k}{{kind="quiet"}} 1.0' in lines
         h = "volcano_pipeline_overlap_seconds"
         assert f"# TYPE {h} histogram" in lines
         assert f"{h}_count 2" in lines

@@ -19,6 +19,7 @@ v2 additions:
 from __future__ import annotations
 
 import argparse
+import ast
 import json
 import os
 import sys
@@ -173,6 +174,22 @@ def _explain(rule_id: str, paths) -> int:
                     continue
                 state = "sealed" if attr in sealed else "UNSEALED"
                 print(f"{path}:{line} {attr:20s} channel={ch:15s} {state}")
+        # the read-set direction (PR 15): channels the seal/intersect
+        # closure CONSUMES, each proved a sealed fingerprint component
+        for fi in model.funcs:
+            if fi.name not in wpm.READSET_CONSUMERS \
+                    or not rule.applies_to(fi.path):
+                continue
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, ast.Load)):
+                    continue
+                if not rule._CHANNEL_ATTR.search(node.attr) \
+                        or node.attr in rule._EXEMPT:
+                    continue
+                state = "sealed" if node.attr in sealed else "UNSEALED"
+                print(f"{fi.path}:{node.lineno} {node.attr:20s} "
+                      f"consumed-by={fi.name:15s} {state}")
         return 0
     print(f"--explain supports VT007/VT008/VT009, not {rule_id}",
           file=sys.stderr)

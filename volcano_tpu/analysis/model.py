@@ -88,6 +88,16 @@ EFFECT_ATTR_BUMPS = {
     "replica_epoch": "replica_epoch",
 }
 
+# read-set seal/intersect consumers (PR 15): the closure roots whose
+# invalidation-channel READS must be a subset of the fingerprint-sealed
+# set (rules.py VT009 consumed-channel pass, shared with --explain).
+# The scoped re-check only runs after the coarse fingerprint moves, so a
+# channel the intersect consults that the seal never covers is a delta
+# the re-check can never be asked about — it commits as a quiet window.
+# Any new mark stream or read-set channel lands here so lint inherits it.
+READSET_CONSUMERS = ("readset_seal", "readset_delta", "marks_since",
+                     "_readset_check", "_seal_readset")
+
 # snapshot-bearing mutating method calls (receiver-attr name)
 MUTATING_CALLS = {
     "add_task", "remove_task", "update_task", "set_node",

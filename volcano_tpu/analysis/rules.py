@@ -1317,7 +1317,16 @@ class FingerprintCompleteness(Rule):
     (``SchedulerCache.pipeline_fingerprint`` + ``PipelineDriver.
     _fingerprint`` and their callee closure) — so adding mutable state
     with its own invalidation counter, without extending the seal, fails
-    lint instead of becoming a rare stale-commit."""
+    lint instead of becoming a rare stale-commit.
+
+    Second direction (PR 15, read-set scope): the seal/intersect path
+    (``model.READSET_CONSUMERS`` — ``readset_seal`` / ``readset_delta``
+    / ``marks_since`` / the driver's check) CONSUMES channels to scope
+    deltas. Every channel that closure reads must itself be a sealed
+    fingerprint component: the intersect only runs after the coarse
+    fingerprint moves, so a channel visible to the intersect but absent
+    from the seal is movement the re-check is never asked about — the
+    stage commits as a quiet window against state it never saw."""
 
     id = "VT009"
     title = "invalidation channel not sealed in the speculation fingerprint"
@@ -1367,6 +1376,64 @@ class FingerprintCompleteness(Rule):
                         f"a speculative solve sealed before this bump "
                         f"would commit against state it never saw; add "
                         f"the channel to the sealed tuple"))
+        findings.extend(self._unsealed_reads(model, path, norm, sealed))
+        return findings
+
+    def _unsealed_reads(self, model, path, norm, sealed):
+        """Consumed-channel pass: channel attrs READ inside the read-set
+        seal/intersect closure (``model.READSET_CONSUMERS`` roots, same
+        bounded callee expansion as the sealed side) but absent from the
+        fingerprint-sealed set. Reads are reported at their lexical site,
+        so each file anchors its own consumers and the whole-program
+        closure never produces a finding in a file the scan isn't on."""
+        findings: List[Finding] = []
+        roots = [fi for fi in model.funcs
+                 if fi.name in wpm.READSET_CONSUMERS
+                 and (fi.path == path
+                      or norm.endswith(fi.path.replace("\\", "/")))]
+        if not roots:
+            return findings
+        member: Set[str] = set()
+        frontier = list(roots)
+        for _ in range(3):
+            nxt: List[wpm.FuncInfo] = []
+            for fn in frontier:
+                if fn.qualname in member:
+                    continue
+                member.add(fn.qualname)
+                for callee in sorted(fn.callees):
+                    nxt.extend(model.resolve(callee, fn))
+            frontier = nxt
+            if not frontier:
+                break
+        reported: Set[tuple] = set()
+        for fi in model.funcs:
+            if fi.qualname not in member \
+                    or fi.name in self.FINGERPRINT_FUNCS:
+                continue
+            fp = fi.path.replace("\\", "/")
+            if fp != norm and not norm.endswith(fp):
+                continue
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, ast.Load)):
+                    continue
+                attr = node.attr
+                if not self._CHANNEL_ATTR.search(attr) \
+                        or attr in self._EXEMPT or attr in sealed:
+                    continue
+                key = (fi.qualname, attr)
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(Finding(
+                    self.id, path, node.lineno, node.col_offset,
+                    f"read-set channel '{attr}' is consumed by the "
+                    f"seal/intersect path ({fi.name}) but never sealed "
+                    f"in the speculation fingerprint — the scoped "
+                    f"re-check only runs when a sealed component moves, "
+                    f"so movement on this channel alone commits as a "
+                    f"quiet window; add it to the sealed tuple"))
         return findings
 
     def _sealed_attrs(self, model, tree, path):

@@ -54,16 +54,29 @@ from volcano_tpu.scheduler import metrics
 logger = logging.getLogger(__name__)
 
 
-def reconcile_session(ssn) -> Optional[Dict]:
+def reconcile_session(ssn, after_epoch: Optional[int] = None) \
+        -> Optional[Dict]:
     """Resolve every outstanding express token against this session.
-    No-op (None) when no lane is attached."""
+    No-op (None) when no lane is attached.
+
+    ``after_epoch`` — the committing pipeline stage's SEALED commit
+    epoch: tokens minted after it (token.epoch > after_epoch) reference
+    jobs this session's snapshot never contained, so reconciling them
+    here would wrongly revert fresh binds ("job left the snapshot").
+    They stay outstanding — counted as ``deferred`` — and resolve in the
+    NEXT session, which the pipeline guarantees runs on a fresh snapshot
+    (speculation refuses to start while tokens are outstanding)."""
     lane = getattr(ssn.cache, "express_lane", None)
     if lane is None:
         return None
     stats = {"confirmed": 0, "reverted": 0, "terminal": 0,
-             "reverted_tasks": 0}
+             "reverted_tasks": 0, "deferred": 0}
     lane.last_reverts = []
     for job_uid in sorted(lane.outstanding):
+        if after_epoch is not None \
+                and lane.outstanding[job_uid].epoch > after_epoch:
+            stats["deferred"] += 1
+            continue
         token = lane.outstanding.pop(job_uid)
         job = ssn.jobs.get(job_uid)
         live = []      # (session task, recorded node) still express-bound

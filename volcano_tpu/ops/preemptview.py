@@ -34,6 +34,11 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from volcano_tpu.api.resource import (
+    MIN_MEMORY,
+    MIN_MILLI_CPU,
+    MIN_MILLI_SCALAR,
+)
 from volcano_tpu.ops import encoder as enc_mod
 from volcano_tpu.scheduler import conf
 from volcano_tpu.scheduler.plugins import nodeorder as nodeorder_mod
@@ -199,8 +204,9 @@ class DensePreemptView:
             self.idle = mat("idle")
             self.rel = mat("releasing")
             self._eps = np.array(
-                [10.0, 10.0 * 1024 * 1024] + [10.0] * (len(self.rnames) - 2),
-                np.float64)  # MIN_MILLI_CPU / MIN_MEMORY / MIN_MILLI_SCALAR
+                [MIN_MILLI_CPU, MIN_MEMORY]
+                + [MIN_MILLI_SCALAR] * (len(self.rnames) - 2),
+                np.float64)
             self._is_scalar = np.array(
                 [False, False] + [True] * (len(self.rnames) - 2))
         self.cnt = np.array([len(nd.tasks) for nd in self.nodes], np.int64)
@@ -683,7 +689,7 @@ class DensePreemptView:
         # epsilon resource fit (Resource.less_equal arithmetic) against
         # idle OR releasing, vectorized over the sig∧cnt-eligible subset
         req = self._req_vec(task.init_resreq)
-        skip = self._is_scalar & (req <= 10.0)
+        skip = self._is_scalar & (req <= MIN_MILLI_SCALAR)
         fit_idle = ((req[None, :] < self.idle[idx] + self._eps[None, :])
                     | skip[None, :]).all(axis=1)
         fit_rel = ((req[None, :] < self.rel[idx] + self._eps[None, :])

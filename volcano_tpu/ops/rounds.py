@@ -81,10 +81,11 @@ from volcano_tpu.ops.kernels import (
 CHUNK = 128
 
 # per-round profile exported through the packed single-fetch result:
+# node-count header (sizes the touched-node mask that precedes the tail),
 # placed-per-round histogram slots plus the scalar tail (round-count limbs,
 # tail_placed, full-sweep round count, capped flag)
 PROF_SLOTS = 64
-PROF_TAIL = 5 + PROF_SLOTS
+PROF_TAIL = 6 + PROF_SLOTS
 
 
 def _job_rank(spec: SolveSpec, enc, job_placed, job_alloc):
@@ -571,25 +572,31 @@ def unpack_layout(layout, bufs):
 
 def pack_result(enc, raw):
     """Pack a solve_rounds result tuple into the ONE fetchable array:
-    assign plus a PROF_TAIL-long profile tail (round-counter limbs,
+    assign, the touched-node mask (which node columns the windowed solve
+    actually gathered — the node half of the read-set descriptor the
+    pipeline's speculative seal records), then a PROF_TAIL-long profile
+    tail (node-count header sizing the mask, round-counter limbs,
     tail_placed, full-sweep round count, capped flag, the placed-per-round
     histogram); int16 when the node count allows (halves the downlink —
-    assign values are node indices or -1/-2)."""
-    (assign, n_rounds, tail_placed, full_sweeps, capped, placed_hist) = raw
+    assign values are node indices or -1/-2; the node count fits the int16
+    limb by the same <= 32766 condition that picks it)."""
+    (assign, n_rounds, tail_placed, full_sweeps, capped, placed_hist,
+     touched) = raw
     n_total = enc["node_idle"].shape[0]
     # tail_placed is bounded by 8*round_min_progress+16; clamp everything to
     # the int16 limb's range so an extreme config can't silently wrap a
     # PROFILE counter (assignments are unaffected)
     tail = jnp.concatenate([
-        jnp.stack([n_rounds & 0x7FFF, n_rounds >> 15,
+        jnp.stack([jnp.int32(n_total), n_rounds & 0x7FFF, n_rounds >> 15,
                    jnp.minimum(tail_placed, 0x7FFF),
                    jnp.minimum(full_sweeps, 0x7FFF),
                    capped.astype(jnp.int32)]),
         jnp.minimum(placed_hist, 0x7FFF)])
     if n_total <= 32766:  # static (trace-time) shape decision
         return jnp.concatenate([assign.astype(jnp.int16),
+                                touched.astype(jnp.int16),
                                 tail.astype(jnp.int16)])
-    return jnp.concatenate([assign, tail])
+    return jnp.concatenate([assign, touched.astype(assign.dtype), tail])
 
 
 @functools.partial(jax.jit, static_argnames=("spec", "layout"))
@@ -612,7 +619,9 @@ def solve_rounds_packed(spec: SolveSpec, layout, bufs):
 def solve_rounds(spec: SolveSpec, enc: dict):
     """Batched allocate session. Returns (assign [T] int32 node or -1,
     rounds used, tail_placed, full-sweep rounds, capped flag,
-    placed-per-round histogram [PROF_SLOTS]).
+    placed-per-round histogram [PROF_SLOTS], touched-node mask [N] bool —
+    the columns the solve consumed, all-ones on any full-width round or
+    capped exit).
 
     Per-task request/has-pod columns are derived on device from the class
     arrays (task_req = cls_req[task_cls]); the per-task float matrices never
@@ -666,6 +675,15 @@ def solve_rounds(spec: SolveSpec, enc: dict):
         dirty=jnp.ones(n_total, bool),
         placed_hist=jnp.zeros(PROF_SLOTS, jnp.int32),
         full_sweeps=jnp.int32(0),
+        # touched-node mask (read-set descriptor, pipeline/driver.py): the
+        # node columns this solve actually consumed. Windowed rounds add
+        # their top-k nominations; any full-width sweep (window_k == 0,
+        # coverage-bit fallback, conservative stall retry resolved full)
+        # and any capped exit (tail pass / serial residue argmax over the
+        # whole axis) degrade it to all-ones — the conservative direction:
+        # over-reporting reads can only shrink the commit rate, never
+        # admit a stale commit
+        touched=jnp.zeros(n_total, bool),
     )
     if spec.use_exclusion:
         st["excl_occ"] = enc["excl_occ0"]
@@ -780,12 +798,18 @@ def solve_rounds(spec: SolveSpec, enc: dict):
                 lambda _: jnp.full(t_total, -1, jnp.int32), None)
             choice = jnp.where(uncovered[task_cls], choice_full, choice_w)
             did_full = run_full
+            # read-set maintenance: a windowed round consumed exactly its
+            # nominated columns; a coverage-bit fallback consumed them all
+            touched = jnp.where(
+                did_full, jnp.ones_like(st["touched"]),
+                st["touched"].at[top_i.reshape(-1)].set(True))
         else:
             nom_f = _nominate_full(spec, enc, scores, idle, cnt, cls_frac,
                                    t_cap)
             choice, cons_choice, _, _ = _select(
                 spec, enc, task_cls, active, rank, n_feas, grank, *nom_f)
             did_full = jnp.bool_(True)
+            touched = jnp.ones_like(st["touched"])
         choice = jnp.where(cons, cons_choice, choice)
         if spec.use_exclusion:
             # within-round mutual exclusion: of the tasks of one group
@@ -856,6 +880,7 @@ def solve_rounds(spec: SolveSpec, enc: dict):
                 jnp.minimum(st["rounds"], jnp.int32(PROF_SLOTS - 1))
             ].add(placed_n.astype(jnp.int32)),  # sum promotes under x64
             full_sweeps=st["full_sweeps"] + did_full.astype(jnp.int32),
+            touched=touched,
         )
 
     def rollback(st):
@@ -948,6 +973,7 @@ def solve_rounds(spec: SolveSpec, enc: dict):
     # is a ~hundreds-iteration scalar loop and must not drag [K, N] state
     placed_hist = st.pop("placed_hist")
     full_sweeps = st.pop("full_sweeps")
+    touched = st.pop("touched")
     st.pop("scores")
     st.pop("dirty")
 
@@ -1098,8 +1124,12 @@ def solve_rounds(spec: SolveSpec, enc: dict):
     assign = jnp.where(
         st["capped"] & want_retry & (assign < 0),
         -2, assign)
+    # a capped exit consumed the whole axis: the tail pass argmaxes over
+    # every node and the serial residue retry walks the live snapshot —
+    # the mask degrades to all-ones (conservative full read)
+    touched = jnp.where(st["capped"], jnp.ones_like(touched), touched)
     return (assign, st["rounds"], st.get("tail_placed", jnp.int32(0)),
-            full_sweeps, st["capped"], placed_hist)
+            full_sweeps, st["capped"], placed_hist, touched)
 
 
 def _le_eps_rows(l, r, eps, is_scalar):

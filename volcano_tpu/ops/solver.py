@@ -463,7 +463,23 @@ class BatchAllocator:
             t1 = time.perf_counter()
             prep = dict(mode=mode, enc=enc, arrays=arrays, t0=t0, t1=t1,
                         spec=None, layout=None, staged=None, pack_s=0.0,
-                        h2d_s=0.0)
+                        h2d_s=0.0,
+                        # host half of the read-set descriptor the pipeline
+                        # seals at speculative dispatch (the node half is
+                        # the kernel's touched mask, parse_packed): the job
+                        # uids the solve encoded, the queue/namespace ids
+                        # whose policy rows it consumed, and the
+                        # conservatism flag — residue/releasing sessions
+                        # run a serial pass over the whole snapshot at
+                        # apply, so the node read set degrades to the full
+                        # axis (driver side)
+                        readset=dict(
+                            job_uids=[j.uid for j in enc.job_infos],
+                            queue_ids=list(enc.queue_uids),
+                            ns_ids=list(enc.ns_names),
+                            read_all_nodes=bool(
+                                enc.residue_count or enc.has_releasing),
+                        ))
 
             if mode == "rounds":
                 from volcano_tpu.ops import rounds as rounds_mod
@@ -557,14 +573,19 @@ class BatchAllocator:
         from volcano_tpu.ops import rounds as rounds_mod
 
         pt = rounds_mod.PROF_TAIL
-        assign = out[:-pt].astype(np.int32, copy=False)
         meta = out[-pt:].astype(np.int64)
+        nb = int(meta[0])  # padded node count: sizes the touched mask
+        assign = out[:-(pt + nb)].astype(np.int32, copy=False)
         return assign, dict(
-            n_rounds=int(meta[0]) | (int(meta[1]) << 15),
-            tail_placed=int(meta[2]),
-            full_sweeps=int(meta[3]),
-            round_capped=bool(meta[4]),
-            placed_hist=meta[5:],
+            n_rounds=int(meta[1]) | (int(meta[2]) << 15),
+            tail_placed=int(meta[3]),
+            full_sweeps=int(meta[4]),
+            round_capped=bool(meta[5]),
+            placed_hist=meta[6:],
+            # touched-node mask (read-set descriptor): which node columns
+            # the solve consumed, padded-axis indexed; all-ones whenever
+            # the kernel could not prove a narrower read
+            touched_nodes=np.asarray(out[-(pt + nb):-pt]) != 0,
         )
 
     def apply_packed(self, ssn, prep: dict, assign: np.ndarray,

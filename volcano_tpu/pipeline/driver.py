@@ -25,13 +25,30 @@ could, in principle, change state) and before any inter-cycle delta. A
 delta fingerprint — the keeper's dirty epoch + generation, the lease
 fence epoch, the summed cache-node accounting generation, and the
 express lane's commit epoch — is sealed at dispatch and re-checked
-before apply. ANY movement means the speculative snapshot is stale: the
-stage is discarded (never fetched into session state, counted per reason
-as ``pipeline_spec_discard{reason}``) and the cycle re-runs
-non-speculatively on fresh state — which is exactly the serial order, so
-the serial loop (``VOLCANO_TPU_PIPELINE=0``) stays the byte-for-byte
-oracle whether speculation is on, off (``VOLCANO_TPU_PIPELINE_SPEC=0``),
-held, or discarded.
+before apply, ALONGSIDE a read-set descriptor of what the sealed solve
+actually consumed: the encoded job uids (plus staged-enqueue flip jobs),
+the queue/namespace policy rows, and — on the device side — the kernel's
+touched-node mask carried in the packed result tail (rounds.py). On
+movement the keeper's typed mark journal (snapkeeper.marks_since) plus a
+belt-and-braces version sweep (cache.readset_delta) classify every delta
+since the seal: deltas provably DISJOINT from the read set commit the
+stage anyway (``pipeline_spec_commits_total{kind="readset"}``; an
+unmoved fingerprint is ``kind="quiet"``), while an intersecting delta —
+or anything disjointness cannot be proven for: generation/fence/mesh/
+conf/replica-epoch movement, a trimmed or disarmed journal, unscoped
+meta marks, membership growth (phantom rows the serial order would have
+admitted this cycle) — discards the stage, counted per family as
+``pipeline_spec_discard{reason="readset:*"}`` (or the coarse reason).
+``VOLCANO_TPU_READSET=0`` restores whole-fingerprint invalidation.
+A discarded stage is never fetched into session state and the cycle
+re-runs non-speculatively on fresh state — which is exactly the serial
+order, so the serial loop (``VOLCANO_TPU_PIPELINE=0``) stays the
+byte-for-byte oracle whether speculation is on, off
+(``VOLCANO_TPU_PIPELINE_SPEC=0``), held, or discarded. A read-set
+commit linearizes the stage AT ITS SEAL POINT: the disjoint deltas that
+arrived mid-solve are consumed by the NEXT cycle's snapshot, exactly as
+if they had arrived one cycle later — legal because, being disjoint,
+they could not have changed what this solve read or what it wrote.
 
 Enqueue runs STAGED in a speculative session: the real EnqueueAction
 executes, the Pending->Inqueue flips (which land on the SHARED PodGroup
@@ -93,16 +110,23 @@ def speculation_enabled() -> bool:
     return os.environ.get("VOLCANO_TPU_PIPELINE_SPEC", "1") != "0"
 
 
+def readset_enabled() -> bool:
+    """VOLCANO_TPU_READSET=0 restores whole-fingerprint invalidation:
+    any movement discards the stage, read set unconsulted."""
+    return os.environ.get("VOLCANO_TPU_READSET", "1") != "0"
+
+
 class _InFlight:
     """One speculative solve-ahead: the early-opened session, its
-    prepared packed dispatch, the sealed fingerprint, and the staged
-    enqueue flips that re-apply only at commit."""
+    prepared packed dispatch, the sealed fingerprint + read set, and the
+    staged enqueue flips that re-apply only at commit."""
 
     __slots__ = ("ssn", "names", "prep", "dev", "wait", "fingerprint",
-                 "flips", "tiers", "t_dispatch")
+                 "flips", "tiers", "t_dispatch", "readset", "out",
+                 "read_nodes", "commit_kind", "audit")
 
     def __init__(self, ssn, names, prep, dev, wait, fingerprint, flips,
-                 tiers, t_dispatch):
+                 tiers, t_dispatch, readset=None):
         self.ssn = ssn
         self.names = names
         self.prep = prep
@@ -112,6 +136,22 @@ class _InFlight:
         self.flips = flips
         self.tiers = tiers
         self.t_dispatch = t_dispatch
+        # sealed read-set descriptor (None => whole-fingerprint scope)
+        self.readset = readset
+        self.out = None          # memoized fetch: the check may need the
+        #                          packed result (touched-node mask) before
+        #                          the commit consumes it — fetch ONCE
+        self.read_nodes = None   # resolved node-name read set, lazy
+        self.commit_kind = "quiet"
+        self.audit = None        # disjointness witness for the sim auditor
+
+    def fetch(self) -> np.ndarray:
+        """The stage's single fetch point: both the read-set check (mask
+        classification) and the commit's parse consume this; whichever
+        runs first pays the sync, the other reuses the array."""
+        if self.out is None:
+            self.out = self.wait()
+        return self.out
 
 
 class PipelineDriver:
@@ -148,11 +188,19 @@ class PipelineDriver:
         cache.enable_pipeline()
         self._inflight: Optional[_InFlight] = None
         self._cycle_walls: List[float] = []
+        # disjointness witnesses for read-set commits (sim auditor): each
+        # entry pairs the delta rows that moved since the seal with the
+        # rows the sealed solve read — the auditor re-proves every
+        # intersection is empty. Bounded ring; the total lives in stats.
+        self.readset_audit: List[Dict] = []
+        self.readset_audit_total = 0  # monotonic: survives ring trims
+        self._AUDIT_CAP = 256
         self.stats: Dict[str, object] = {
             "cycles": 0, "committed": 0, "fallback_cycles": 0,
             "spec_dispatched": 0, "spec_applied": 0, "spec_discarded": 0,
             "spec_reruns": 0, "stale_commits": 0,
             "spec_discards": {}, "spec_skips": {},
+            "spec_commits": {}, "readset_audits": 0,
         }
 
     @property
@@ -182,6 +230,7 @@ class PipelineDriver:
         now = self._fingerprint(tiers)
         old = st.fingerprint
         if now == old:
+            st.commit_kind = "quiet"
             return True, ""
         # attribute the discard to the first component that moved — the
         # metric label operators alert on
@@ -191,19 +240,156 @@ class PipelineDriver:
             return False, "mesh"
         if o_tiers != n_tiers:
             return False, "conf_changed"
-        if o_epoch != n_epoch:
-            return False, "express_commit"
+        if st.readset is None:
+            # whole-fingerprint scope (VOLCANO_TPU_READSET=0 or the seal
+            # degraded at dispatch): ANY movement discards
+            if o_epoch != n_epoch:
+                return False, "express_commit"
+            if o_cache[2] != n_cache[2]:
+                return False, "fence_epoch"
+            if o_cache[1] != n_cache[1]:
+                return False, "generation"
+            if o_cache[0] != n_cache[0]:
+                return False, "watch_delta"
+            if o_cache[5:7] != n_cache[5:7]:
+                # job-side belt-and-braces (VT009): an unmarked job
+                # mutation moved the status-version sum without touching
+                # dirty epoch
+                return False, "job_version"
+            return False, "acct_gen"
+        # read-set scope: coarse channels no journal entry can scope —
+        # lease fences, full invalidations, replica-buffer supersession —
+        # stay whole-snapshot conservative
         if o_cache[2] != n_cache[2]:
             return False, "fence_epoch"
         if o_cache[1] != n_cache[1]:
             return False, "generation"
-        if o_cache[0] != n_cache[0]:
-            return False, "watch_delta"
-        if o_cache[5:7] != n_cache[5:7]:
-            # job-side belt-and-braces (VT009): an unmarked job mutation
-            # moved the status-version sum without touching dirty epoch
-            return False, "job_version"
-        return False, "acct_gen"
+        if o_cache[7] != n_cache[7]:
+            return False, "readset:replica"
+        return self._readset_check(st, o_epoch, n_epoch)
+
+    def _readset_check(self, st: _InFlight, o_epoch: int,
+                       n_epoch: int) -> Tuple[bool, str]:
+        """Classify every delta since the seal against the stage's read
+        set. Commit (kind="readset") only when EVERY delta is provably
+        disjoint; the first unprovable or intersecting delta names the
+        discard family. Consumes the keeper journal via the seal cursor
+        (cache.readset_delta) — non-destructively, so the apply-time
+        re-probe reaches the same verdict."""
+        rs = st.readset
+        delta = self.cache.readset_delta(rs["seal"])
+        if delta is None:
+            # journal disarmed / trimmed past the cursor / marks
+            # unaccounted: disjointness unprovable
+            return False, "readset:journal"
+        # express epoch movement: each post-seal optimistic commit must
+        # be an outstanding token (the lane was EMPTY at seal — the
+        # speculation gate) whose bind rows we can test like any other
+        # delta; its job uid is NEW by construction, exempt from the
+        # phantom rule, and its reconcile defers past this commit
+        # (_preamble passes the sealed epoch to reconcile_session)
+        express_jobs = set()
+        if n_epoch != o_epoch:
+            lane = getattr(self.cache, "express_lane", None)
+            toks = list(lane.outstanding.values()) if lane is not None \
+                else []
+            if n_epoch - o_epoch != len(toks) or not toks:
+                return False, "express_commit"
+            for tok in toks:
+                if not getattr(tok, "binds", None):
+                    # a token with no recorded bind rows cannot be
+                    # scoped — degrade to the coarse express discard
+                    return False, "express_commit"
+                express_jobs.add(tok.job_uid)
+        read_jobs = rs["read_jobs"]
+        sealed_jobs = rs["seal"]["jobs"]
+        moved_jobs = set(delta["changed_jobs"])
+        moved_nodes = set(delta["changed_nodes"])
+        moved_metas = []
+        for entry in delta["marks"]:
+            kind = entry[0]
+            if kind == "job":
+                moved_jobs.add(entry[1])
+            elif kind == "node":
+                moved_nodes.add(entry[1])
+            elif kind == "meta":
+                moved_metas.append(entry)
+            else:
+                # ("gen",) or an unknown mark kind: a full invalidation
+                # should have been caught by the generation gate — treat
+                # any surprise as unprovable
+                return False, "readset:journal"
+        for uid in sorted(moved_jobs):
+            if uid in read_jobs:
+                return False, "readset:job"
+            if uid not in sealed_jobs and uid not in express_jobs:
+                # membership growth: a job the serial order would have
+                # admitted into THIS cycle's encode — committing over it
+                # would reorder it behind work it may outrank
+                return False, "readset:phantom"
+        for entry in moved_metas:
+            mkind = entry[1] if len(entry) > 1 else ""
+            muid = entry[2] if len(entry) > 2 else ""
+            if mkind == "queue":
+                if not muid or muid in rs["read_queues"]:
+                    return False, "readset:queue"
+            elif mkind == "quota":
+                if not muid or muid in rs["read_ns"]:
+                    return False, "readset:ns"
+            else:
+                # unscoped policy movement: unprovable
+                return False, "readset:meta"
+        if moved_nodes:
+            if rs["read_all_nodes"]:
+                # residue/releasing apply or backfill-eligible work reads
+                # the whole node axis serially — any node movement
+                # intersects
+                return False, "readset:node"
+            read_nodes = self._read_node_set(st)
+            if read_nodes is None:
+                return False, "readset:fetch"
+            sealed_axis = rs["sealed_axis"]
+            for name in sorted(moved_nodes):
+                if name in read_nodes:
+                    return False, "readset:node"
+                if name not in sealed_axis:
+                    # capacity that was not in the sealed ready axis
+                    # (new node, or one that just became ready): the
+                    # serial order would have offered it to this cycle's
+                    # solve — phantom, same as a new job
+                    return False, "readset:phantom"
+        st.commit_kind = "readset"
+        st.audit = {
+            "delta_jobs": sorted(moved_jobs),
+            "delta_nodes": sorted(moved_nodes),
+            "delta_metas": [tuple(e[1:]) for e in moved_metas],
+            "read_jobs": sorted(read_jobs),
+            "read_nodes": sorted(st.read_nodes)
+            if st.read_nodes is not None else [],
+            "read_queues": sorted(rs["read_queues"]),
+            "read_ns": sorted(rs["read_ns"]),
+        }
+        return True, ""
+
+    def _read_node_set(self, st: _InFlight):
+        """The stage's node read set: the kernel's touched mask from the
+        packed result tail, mapped back through the encode's node axis.
+        Fetching here is the same sync the commit was about to pay — the
+        array is memoized on the stage (st.fetch) and reused by the
+        apply. None on fetch/parse failure (caller degrades)."""
+        if st.read_nodes is not None:
+            return st.read_nodes
+        try:
+            _assign, meta = st.ssn.batch_allocator.parse_packed(st.fetch())
+            mask = meta["touched_nodes"]
+        except Exception:
+            logger.exception("readset mask fetch failed; conservative "
+                             "discard")
+            return None
+        names = st.prep["enc"].node_names
+        st.read_nodes = {names[i] for i in np.nonzero(mask)[0]
+                         if i < len(names)}
+        return st.read_nodes
 
     # -- cycle entry ---------------------------------------------------------
 
@@ -283,16 +469,22 @@ class PipelineDriver:
         order = [n for n in _CHAIN if n in names]
         return list(names) == order
 
-    def _preamble(self, ssn) -> None:
+    def _preamble(self, ssn, reconcile_after: Optional[int] = None) -> None:
         """The run_actions head every COMMITTING session owes: express
         reconciliation (the session is the fairness authority for every
-        outstanding optimistic bind) and the takeover recovery sweep."""
+        outstanding optimistic bind) and the takeover recovery sweep.
+
+        ``reconcile_after`` — a read-set commit's sealed express epoch:
+        tokens minted AFTER the seal reference jobs this session's
+        snapshot never saw, so they stay outstanding and reconcile next
+        cycle (which runs serially — the pipeline refuses to speculate
+        while tokens are outstanding)."""
         lane = getattr(self.cache, "express_lane", None)
         if lane is not None:
             from volcano_tpu.express.reconcile import reconcile_session
 
             lane.set_tiers(ssn.tiers)
-            reconcile_session(ssn)
+            reconcile_session(ssn, after_epoch=reconcile_after)
         if getattr(self.cache, "fence_sweep_due", False):
             self.cache.fence_sweep_due = False
             takeover_recovery_sweep(ssn)
@@ -409,11 +601,14 @@ class PipelineDriver:
             self._skip(info, "fence_sweep_due")
             return
         ssn = open_session(self.cache, tiers)
-        flips = self._staged_enqueue(ssn) if "enqueue" in names else []
-        if flips is None:
-            self._release(ssn)
-            self._skip(info, "enqueue_active")
-            return
+        flips, flip_uids = [], []
+        if "enqueue" in names:
+            staged = self._staged_enqueue(ssn)
+            if staged is None:
+                self._release(ssn)
+                self._skip(info, "enqueue_active")
+                return
+            flips, flip_uids = staged
         # encode with the staged flips APPLIED (the encoder excludes
         # Pending-phase jobs — encoder.py job gate), then park them until
         # commit: the shared PodGroup objects must carry zero observable
@@ -430,6 +625,7 @@ class PipelineDriver:
             self._skip(info, "not_packed_rounds")
             return
         fingerprint = self._fingerprint(tiers)
+        readset = self._seal_readset(ssn, names, prep, flip_uids)
         try:
             from volcano_tpu.ops import rounds as rounds_mod
             from volcano_tpu.utils import devprof
@@ -448,9 +644,64 @@ class PipelineDriver:
             self._skip(info, "dispatch_error")
             return
         self._inflight = _InFlight(ssn, names, prep, dev, wait,
-                                   fingerprint, flips, tiers, t_dispatch)
+                                   fingerprint, flips, tiers, t_dispatch,
+                                   readset=readset)
         self.stats["spec_dispatched"] += 1
         info.setdefault("spec", "dispatched")
+
+    def _seal_readset(self, ssn, names, prep, flip_uids):
+        """Seal the stage's read-set descriptor next to the coarse
+        fingerprint: the host half from the prepare (encoded job uids,
+        queue/namespace policy rows, the residue/releasing conservatism
+        flag), the staged-enqueue flip jobs (their phase re-applies at
+        commit, so post-seal movement on them must discard), the
+        backfill-eligibility widening (backfill binds onto ANY node of
+        its stale snapshot, so its node read set is the whole axis), and
+        the keeper's journal cursor + version baselines
+        (cache.readset_seal). None degrades the stage to whole-
+        fingerprint scope — never to a wrong commit."""
+        if not readset_enabled():
+            return None
+        rs = prep.get("readset")
+        if rs is None:
+            return None
+        try:
+            seal = self.cache.readset_seal()
+        except Exception:
+            logger.exception("readset seal failed; whole-fingerprint "
+                             "scope for this stage")
+            return None
+        read_all = bool(rs.get("read_all_nodes"))
+        if not read_all and "backfill" in names \
+                and self._backfill_work(ssn):
+            read_all = True
+        return {
+            "seal": seal,
+            "read_jobs": set(rs["job_uids"]) | set(flip_uids),
+            "read_queues": set(rs["queue_ids"]),
+            "read_ns": set(rs["ns_ids"]),
+            "read_all_nodes": read_all,
+            # the encode's READY node axis: movement on any row outside
+            # it is capacity this solve was never offered
+            "sealed_axis": set(prep["enc"].node_names),
+        }
+
+    @staticmethod
+    def _backfill_work(ssn) -> bool:
+        """Does the sealed session hold backfill-eligible work (a
+        PENDING task with an empty init resreq on a started job —
+        actions/backfill.py eligibility)? If so the backfill pass reads
+        every node, and the stage's node read set widens to the axis."""
+        PENDING = objects.PodGroupPhase.PENDING
+        for job in ssn.jobs.values():
+            pg = job.pod_group
+            if pg is not None and pg.status.phase == PENDING:
+                continue
+            for task in job.task_status_index.get(
+                    TaskStatus.PENDING, {}).values():
+                if task.init_resreq.is_empty():
+                    return True
+        return False
 
     def _staged_enqueue(self, ssn):
         """Run the REAL enqueue action and record its Pending->Inqueue
@@ -461,7 +712,10 @@ class PipelineDriver:
         still APPLIED (the encode needs the admitted phase), or None when
         a flipped job already has pending tasks — the serial order would
         let allocate see it admitted this cycle, so the cycle must not
-        speculate (the caller reverts before declining)."""
+        speculate (the caller reverts before declining). The flip JOB
+        uids ride along as ``(flips, flip_uids)`` — they join the
+        stage's job read set (the commit re-applies their phase, so
+        post-seal movement on them must discard)."""
         PENDING = objects.PodGroupPhase.PENDING
         before = []
         for job in ssn.jobs.values():
@@ -470,17 +724,19 @@ class PipelineDriver:
                 before.append((job, pg))
         get_action("enqueue").execute(ssn)
         flips = []
+        flip_uids = []
         active = False
         for job, pg in before:
             if pg.status.phase == objects.PodGroupPhase.INQUEUE:
                 flips.append(pg)
+                flip_uids.append(job.uid)
                 if job.task_status_index.get(TaskStatus.PENDING):
                     active = True
         if active:
             for pg in flips:
                 pg.status.phase = PENDING
             return None
-        return flips
+        return flips, flip_uids
 
     # -- commit / discard ----------------------------------------------------
 
@@ -491,8 +747,10 @@ class PipelineDriver:
         ssn = st.ssn
         solver = ssn.batch_allocator
         t0 = time.perf_counter()
-        self._preamble(ssn)  # no outstanding tokens by fingerprint;
-        #                      reconcile still bumps the lane's session seq
+        # quiet commit: no outstanding tokens by fingerprint, reconcile
+        # still bumps the lane's session seq. Read-set commit: post-seal
+        # tokens (already proven disjoint) defer past this session.
+        self._preamble(ssn, reconcile_after=st.fingerprint[1])
         for pg in st.flips:
             pg.status.phase = objects.PodGroupPhase.INQUEUE
         # apply-time re-check, the sim auditor's pipeline_no_stale_commit
@@ -514,7 +772,7 @@ class PipelineDriver:
             return None
         t_wait = time.perf_counter()
         overlap_s = t_wait - st.t_dispatch
-        if not self._solve_and_apply(ssn, solver, st.prep, wait=st.wait):
+        if not self._solve_and_apply(ssn, solver, st.prep, wait=st.fetch):
             # fetch failed: treat exactly like a discard — nothing from
             # this stage was applied — and let the caller re-run
             self._note_discard("kernel_error")
@@ -534,10 +792,21 @@ class PipelineDriver:
             action_ms["backfill"] = round(
                 (time.perf_counter() - t1) * 1e3, 3)
         self.stats["spec_applied"] += 1
+        kind = st.commit_kind
+        commits = self.stats["spec_commits"]
+        commits[kind] = commits.get(kind, 0) + 1
+        metrics.register_pipeline_spec_commit(kind)
+        if st.audit is not None:
+            self.readset_audit.append(st.audit)
+            self.readset_audit_total += 1
+            self.stats["readset_audits"] += 1
+            if len(self.readset_audit) > self._AUDIT_CAP:
+                del self.readset_audit[0]
         metrics.observe_pipeline_overlap(overlap_s)
         info["mode"] = "speculative"
         info["overlap_ms"] = round(overlap_s * 1e3, 3)
         info["spec_applied"] = True
+        info["spec_commit"] = kind
         info["action_ms"] = action_ms
         return ssn
 

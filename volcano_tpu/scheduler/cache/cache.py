@@ -637,8 +637,10 @@ class SchedulerCache:
             else:
                 # spec updates (weight, capability) re-derive fresh next
                 # snapshot, but a speculative solve sealed under the old
-                # policy must be invalidated (snapkeeper.mark_meta)
-                self.snap_keeper.mark_meta()
+                # policy must be invalidated (snapkeeper.mark_meta) —
+                # scoped to the queue so the read-set intersect can let
+                # noise on a queue the sealed solve never consumed commit
+                self.snap_keeper.mark_meta("queue", queue.metadata.name)
             self.queues[queue.metadata.name] = QueueInfo(queue)
 
     def update_queue_from_watch(self, old: objects.Queue, new: objects.Queue) -> None:
@@ -683,8 +685,8 @@ class SchedulerCache:
             coll.update(quota)
             # namespace weights re-derive fresh each snapshot; the epoch
             # bump invalidates any speculative solve sealed under the
-            # old weights (snapkeeper.mark_meta)
-            self.snap_keeper.mark_meta()
+            # old weights (snapkeeper.mark_meta), scoped to the namespace
+            self.snap_keeper.mark_meta("quota", ns)
 
     def update_resource_quota_from_watch(self, old, new) -> None:
         self.add_resource_quota(new)
@@ -696,7 +698,7 @@ class SchedulerCache:
                 coll.delete(quota)
                 if coll.empty():
                     del self.namespace_collection[quota.metadata.namespace]
-                self.snap_keeper.mark_meta()
+                self.snap_keeper.mark_meta("quota", quota.metadata.namespace)
 
     # -- pdb handlers ------------------------------------------------------
 
@@ -1142,3 +1144,57 @@ class SchedulerCache:
                     self.fence_epoch, acct, len(self.nodes),
                     jver, len(self.jobs),
                     rep.replica_epoch if rep is not None else -1)
+
+    def readset_seal(self) -> dict:
+        """Capture the read-set seal baseline for a speculative dispatch
+        (read-set-scoped invalidation, pipeline/driver.py): the mark
+        journal cursor (dirty_epoch; the journal is armed here on first
+        use), per-row version baselines for every node and job, and the
+        queue/namespace id sets the sealed snapshot could have consumed.
+        One locked O(N+J) pass — the same complexity class as the
+        fingerprint itself, taken at the same moment so the cursor and
+        the baselines describe one consistent state."""
+        with self._lock:
+            keeper = self.snap_keeper
+            keeper.enable_journal()
+            return {
+                "cursor": keeper.dirty_epoch,
+                "node_gens": {name: node._acct_gen
+                              for name, node in self.nodes.items()},
+                "job_vers": {uid: job._status_version
+                             for uid, job in self.jobs.items()},
+                "jobs": set(self.jobs.keys()),
+                "queues": set(self.queues.keys()),
+                "namespaces": set(self.namespace_collection.keys()),
+            }
+
+    def readset_delta(self, seal: dict):
+        """The rows that moved since ``readset_seal``: the journal's
+        typed marks past the seal cursor PLUS the belt-and-braces version
+        sweep (rows whose _acct_gen/_status_version moved without a mark
+        — exactly the unmarked-mutation class vclint VT009 exists for;
+        the sweep makes the intersect safe against them instead of
+        trusting the lint alone). Returns ``None`` when the journal
+        window is unprovable — the caller must degrade to the
+        whole-fingerprint discard."""
+        with self._lock:
+            marks = self.snap_keeper.marks_since(seal["cursor"])
+            if marks is None:
+                return None
+            node_gens = seal["node_gens"]
+            changed_nodes = {
+                name for name, node in self.nodes.items()
+                if node._acct_gen != node_gens.get(name)}
+            changed_nodes.update(n for n in node_gens
+                                 if n not in self.nodes)
+            job_vers = seal["job_vers"]
+            changed_jobs = {
+                uid for uid, job in self.jobs.items()
+                if job._status_version != job_vers.get(uid)}
+            changed_jobs.update(u for u in job_vers
+                                if u not in self.jobs)
+            return {
+                "marks": list(marks),
+                "changed_nodes": changed_nodes,
+                "changed_jobs": changed_jobs,
+            }

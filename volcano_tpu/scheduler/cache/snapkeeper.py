@@ -116,6 +116,19 @@ class SnapshotKeeper:
         # captured at dispatch and re-checked before apply detects ANY
         # state movement the speculative snapshot did not see
         self.dirty_epoch = 0
+        # mark journal (read-set-scoped speculation): when armed, every
+        # dirty_epoch bump appends exactly one typed entry — ("job", uid),
+        # ("node", name), ("meta", kind, uid) or ("gen",) — so a consumer
+        # that captured dirty_epoch at seal can later ask WHICH rows moved
+        # (marks_since) instead of only THAT something moved. The journal
+        # is bounded: a front trim advances journal_base, and any cursor
+        # behind the base (or an epoch bump that bypassed the journal)
+        # makes the window unprovable — marks_since then returns None and
+        # the caller must degrade to the whole-fingerprint discard.
+        self.journal_enabled = False
+        self.journal: list = []
+        self.journal_base = 0
+        self.JOURNAL_CAP = 8192
         # pipeline double-buffer: when armed (enable_pair), marks land in
         # BOTH buffers' dirty sets and swap() alternates which buffer the
         # next snapshot builds — session N and session N+1 then never
@@ -178,6 +191,8 @@ class SnapshotKeeper:
         if uid:
             self.dirty_jobs.add(uid)
             self.dirty_epoch += 1
+            if self.journal_enabled:
+                self._journal(("job", uid))
             if self._standby is not None:
                 self._standby.dirty_jobs.add(uid)
             for sh in self.shadows:
@@ -187,6 +202,8 @@ class SnapshotKeeper:
         if name:
             self.dirty_nodes.add(name)
             self.dirty_epoch += 1
+            if self.journal_enabled:
+                self._journal(("node", name))
             if self._standby is not None:
                 self._standby.dirty_nodes.add(name)
             for sh in self.shadows:
@@ -201,21 +218,64 @@ class SnapshotKeeper:
         self.mark_node(node_name)
         self.stats["evict_marks"] += 1
 
-    def mark_meta(self) -> None:
+    def mark_meta(self, kind: str = "", uid: str = "") -> None:
         """A policy-level delta the per-object dirty-sets don't model —
         an existing queue's spec update, a namespace quota change.
         QueueInfos and namespace weights are re-derived fresh every
         snapshot, so no clone needs invalidating; but the pipeline's
         speculative solve-ahead read the OLD policy, so the fingerprint
         epoch must move or a sealed stage could commit against a weight
-        the serial order would not have used."""
+        the serial order would not have used. ``kind``/``uid`` scope the
+        journal entry ("queue"/name, "quota"/namespace) so the read-set
+        intersect can tell noise on an id the sealed solve never consumed
+        from movement of a policy row it did; an unscoped call journals
+        as unknown and the intersect must treat it as a hit."""
         self.dirty_epoch += 1
+        if self.journal_enabled:
+            self._journal(("meta", kind, uid))
 
     def invalidate(self) -> None:
         self.generation += 1
         self.dirty_epoch += 1
+        if self.journal_enabled:
+            self._journal(("gen",))
         for sh in self.shadows:
             sh.generation += 1
+
+    # -- mark journal (read-set-scoped speculation) -------------------------
+
+    def enable_journal(self) -> None:
+        """Arm the mark journal (idempotent; caller holds the cache lock).
+        Arming anchors the base at the CURRENT dirty_epoch — bumps before
+        this moment are deliberately unprovable."""
+        if not self.journal_enabled:
+            self.journal_enabled = True
+            self.journal = []
+            self.journal_base = self.dirty_epoch
+
+    def _journal(self, entry) -> None:
+        j = self.journal
+        j.append(entry)
+        if len(j) > self.JOURNAL_CAP:
+            drop = len(j) - self.JOURNAL_CAP // 2
+            del j[:drop]
+            self.journal_base += drop
+
+    def marks_since(self, cursor: int):
+        """The typed mark entries for every dirty_epoch bump past
+        ``cursor`` (a dirty_epoch captured at seal), oldest first — or
+        ``None`` when the window is unprovable: journal disarmed when the
+        cursor was taken, cursor trimmed past, or an epoch bump that
+        bypassed the journal (entry count must equal the epoch delta
+        exactly; anything else means an unjournaled movement and the
+        caller degrades to the whole-fingerprint discard)."""
+        if not self.journal_enabled:
+            return None
+        if cursor < self.journal_base:
+            return None
+        if self.journal_base + len(self.journal) != self.dirty_epoch:
+            return None
+        return self.journal[cursor - self.journal_base:]
 
     # -- bulk-flush sync ----------------------------------------------------
 

@@ -160,6 +160,11 @@ class Registry:
             f"{_NAMESPACE}_pipeline_spec_discards_total",
             "Speculative solve-ahead stages discarded before apply, "
             "by invalidation reason", ("reason",))
+        self.pipeline_spec_commits = Counter(
+            f"{_NAMESPACE}_pipeline_spec_commits_total",
+            "Speculative solve-ahead stages committed, by kind: quiet "
+            "(fingerprint unmoved) vs readset (state moved but every "
+            "delta proven disjoint from the stage's read set)", ("kind",))
         self.pipeline_overlap = Histogram(
             f"{_NAMESPACE}_pipeline_overlap_seconds",
             "Host work overlapped with an in-flight speculative device "
@@ -324,6 +329,10 @@ def register_pipeline_spec_discard(reason: str, n: int = 1) -> None:
     registry().pipeline_spec_discards.inc((reason,), n)
 
 
+def register_pipeline_spec_commit(kind: str, n: int = 1) -> None:
+    registry().pipeline_spec_commits.inc((kind,), n)
+
+
 def observe_pipeline_overlap(seconds: float) -> None:
     registry().pipeline_overlap.observe(seconds)
 
@@ -374,8 +383,8 @@ def render() -> str:
         r.unschedule_task_count, r.unschedule_job_count, r.job_retry_counts,
         r.express_placements, r.express_reverted, r.express_deferred,
         r.leader_transitions, r.fenced_writes_rejected,
-        r.pipeline_spec_discards, r.watch_events_coalesced,
-        r.admission_shed,
+        r.pipeline_spec_discards, r.pipeline_spec_commits,
+        r.watch_events_coalesced, r.admission_shed,
     ):
         lines.append(f"# HELP {c.name} {c.help}")
         lines.append(f"# TYPE {c.name} counter")

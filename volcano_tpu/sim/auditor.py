@@ -389,8 +389,15 @@ class Auditor:
         - express extension (express_reconciliation across pipelined
           sessions): while tokens are outstanding, any in-flight
           speculation must have sealed a DIFFERENT lane commit epoch —
-          i.e. it is already doomed to discard, so the session that
-          reconciles those tokens can never be the sealed one."""
+          it can only commit by PROVING the tokens' rows disjoint (their
+          reconcile then defers to the next serial cycle), never by
+          silently bypassing their verdicts;
+        - read-set disjointness: every read-set commit banked a witness
+          pairing the deltas that moved since its seal with the rows the
+          sealed solve read — the auditor re-proves each intersection
+          empty (a non-empty one means a stage applied OVER state it
+          consumed: the scoped seal committed something the
+          whole-fingerprint seal would have discarded for cause)."""
         out: List[Violation] = []
         drv = getattr(self.sim, "pipeline_driver", None)
         if drv is None and not getattr(
@@ -438,6 +445,37 @@ class Auditor:
                     {"sealed_epoch": sealed_epoch,
                      "commit_epoch": lane.commit_epoch,
                      "outstanding": sorted(lane.outstanding)[:20]}))
+        if drv is not None:
+            # the witness ring trims at its cap, so progress is tracked
+            # against the driver's monotonic total, per driver generation
+            flagged_map = getattr(self, "_readset_audit_flagged", {})
+            total = drv.readset_audit_total
+            audits = drv.readset_audit
+            new = min(total - flagged_map.get(id(drv), 0), len(audits))
+            for witness in (audits[-new:] if new > 0 else []):
+                hits = {
+                    "jobs": sorted(set(witness["delta_jobs"])
+                                   & set(witness["read_jobs"])),
+                    "nodes": sorted(set(witness["delta_nodes"])
+                                    & set(witness["read_nodes"])),
+                    "queues": sorted(
+                        {m[1] for m in witness["delta_metas"]
+                         if m and m[0] == "queue"}
+                        & set(witness["read_queues"])),
+                    "ns": sorted(
+                        {m[1] for m in witness["delta_metas"]
+                         if m and m[0] == "quota"}
+                        & set(witness["read_ns"])),
+                }
+                if any(hits.values()):
+                    out.append(Violation(
+                        "pipeline_no_stale_commit", "readset-disjoint",
+                        "a read-set commit's delta rows intersect the "
+                        "rows its sealed solve read — the scoped seal "
+                        "applied a stage over state it consumed",
+                        {"intersections": hits, "witness": witness}))
+            flagged_map[id(drv)] = total
+            self._readset_audit_flagged = flagged_map
         return out
 
     def _check_front_door(self, session: int) -> List[Violation]:
@@ -583,7 +621,25 @@ class Auditor:
         plain max rate or ``{max: <rate>, min_n: <samples>}``; the check
         stays silent until the denominator reaches ``min_n`` (default
         25) so a cold run's transient can't fail a budget it never got
-        to amortize."""
+        to amortize.
+
+        ``{min: <rate>, min_n: <samples>}`` pins a MINIMUM instead — the
+        witness that a throughput feature keeps DOING its job, not just
+        that it stays honest: ``pipeline_spec_commit_rate`` (stages
+        applied per dispatch) budgets the read-set scope's whole point,
+        committing the solve-ahead under real churn. A max and a min may
+        be combined in one entry.
+
+        ``max_scale`` pins the entry to runs at or below that
+        ``scale_scenario`` factor. Max budgets are naturally
+        scale-robust (a fallback tax stays a tax at any size), but a
+        commit-rate FLOOR is calibrated against the gate-scale regime:
+        at full scale a storm's every inter-cycle window carries a
+        genuinely intersecting delta (express placements of sealed-in
+        jobs, arrival phantoms), so the honest commit rate collapses to
+        ~0 and a floor that fired there would punish correct
+        conservatism. The floor is a tier-1 witness, not a full-scale
+        law."""
         out: List[Violation] = []
         budgets = self.cfg.get("budgets") or {}
         if not budgets:
@@ -595,28 +651,46 @@ class Auditor:
             "express_deferral_rate": rates.get("express_arrivals", 0),
             "pipeline_spec_discard_rate": rates.get(
                 "pipeline_spec_dispatched", 0),
+            "pipeline_spec_commit_rate": rates.get(
+                "pipeline_spec_dispatched", 0),
             "admission_shed_rate": rates.get("admission_attempts", 0),
             "watch_coalesce_rate": rates.get("watch_events_handled", 0),
             "replica_rebuild_rate": rates.get("replica_serves", 0),
         }
         for name in sorted(budgets):
             spec = budgets[name]
+            floor = None
             if isinstance(spec, dict):
-                limit = float(spec.get("max", 1.0))
+                limit = float(spec["max"]) if "max" in spec else None
+                floor = float(spec["min"]) if "min" in spec else None
                 min_n = int(spec.get("min_n", 25))
+                if "max_scale" in spec and \
+                        float(self.sim.cfg.get("_scale", 1.0)) \
+                        > float(spec["max_scale"]) + 1e-12:
+                    continue
+                if limit is None and floor is None:
+                    limit = 1.0
             else:
                 limit, min_n = float(spec), 25
             rate = rates.get(name)
             n = denominators.get(name, 0)
             if rate is None or n < min_n:
                 continue
-            if rate > limit + 1e-12:
+            if limit is not None and rate > limit + 1e-12:
                 out.append(Violation(
                     "fallback_budget", name,
                     f"{name} = {rate} exceeds the scenario budget "
                     f"{limit} over {n} samples — the envelope regressed "
                     f"(see fallbacks counts in the run summary)",
                     {"rate": rate, "budget": limit, "samples": n,
+                     "fallbacks": rates}))
+            if floor is not None and rate < floor - 1e-12:
+                out.append(Violation(
+                    "fallback_budget", name,
+                    f"{name} = {rate} fell below the scenario minimum "
+                    f"{floor} over {n} samples — the feature this rate "
+                    f"witnesses stopped earning its keep",
+                    {"rate": rate, "minimum": floor, "samples": n,
                      "fallbacks": rates}))
         return out
 

@@ -889,6 +889,14 @@ class SimCluster:
             out["pipeline_spec_discards"] = stats.get("spec_discarded", 0)
             out["pipeline_spec_discard_rate"] = round(
                 stats.get("spec_discarded", 0) / max(dispatched, 1), 4)
+            # the read-set headline, as a MINIMUM-budget rate: of the
+            # stages dispatched, how many actually applied (quiet +
+            # readset commits). The whole-fingerprint seal holds this
+            # near zero under churn; read-set scoping must keep it up
+            out["pipeline_spec_commits"] = dict(
+                stats.get("spec_commits", {}))
+            out["pipeline_spec_commit_rate"] = round(
+                stats.get("spec_applied", 0) / max(dispatched, 1), 4)
         rep_stats = self.replica_stats_combined()
         if rep_stats.get("serves"):
             # device-replica envelope: wholesale restages per serve.

@@ -87,6 +87,16 @@ DEFAULTS: Dict = {
         # None keeps the sampling draw-order of every existing scenario
         # byte-identical.
         "interactive": None,
+        # standing backlog: N gangs submitted once at t=0 whose per-task
+        # request exceeds any node's capacity, so they stay pending for
+        # the whole run — the queue depth real clusters always carry.
+        # Deterministic (zero RNG draws), so scenarios that do not opt in
+        # keep their exact sampling streams. Gives the pipelined loop a
+        # non-empty solve-ahead even when the live workload drains every
+        # cycle — without it an under-subscribed scenario never exercises
+        # the speculation ledger at all.
+        # {jobs: 5, tasks: 2, cpu: "16", mem: "24Gi", queue: ...}
+        "standing": None,
     },
     "mirrors": {"kinds": ["Pod", "Node", "PodGroup"], "cap": 512},
     # express lane (volcano_tpu/express): event-driven placement slices
@@ -160,6 +170,10 @@ def scale_scenario(cfg: Dict, scale: float) -> Dict:
     cl["nodes"] = max(int(cl["nodes"] * scale), 2)
     wl = out["workload"]
     wl["initial_jobs"] = max(int(wl["initial_jobs"] * scale), 1)
+    if wl.get("standing"):
+        wl["standing"] = dict(wl["standing"])
+        wl["standing"]["jobs"] = max(
+            int(int(wl["standing"].get("jobs", 0)) * scale), 1)
     if wl["max_jobs"] is not None:
         wl["max_jobs"] = max(int(wl["max_jobs"] * scale), 1)
     arrival = wl["arrival"]
@@ -340,6 +354,30 @@ class Workload:
             return
         for _ in range(int(self.wl["initial_jobs"])):
             self._submit()
+        std = self.wl.get("standing")
+        if std:
+            # the standing backlog draws NOTHING from the rng: shapes are
+            # fixed by the scenario, so opting in perturbs no other
+            # scenario's sampled stream
+            tasks = int(std.get("tasks", 2))
+            shape = {
+                "tasks": tasks,
+                "min_member": int(std.get("min_member", tasks)),
+                "namespace": sorted(self.wl["namespaces"])[0],
+                "queue": str(std.get("queue", sorted(
+                    q["name"] for q in self.cfg["queues"])[0])),
+                "cpu": str(std.get("cpu", "1000m")),
+                "mem": str(std.get("mem", "1Gi")),
+                "gpu": 0,
+                "priority": int(list(self.wl["priorities"])[0]),
+                "service_s": float(self.wl["service_s"][1]),
+                "fail": False,
+                "cancel": False,
+                "resubmit": False,
+                "interactive": False,
+            }
+            for _ in range(int(std.get("jobs", 0))):
+                self._submit(shape=dict(shape))
         self._schedule_arrival()
 
     # -- arrivals ----------------------------------------------------------

@@ -1,0 +1,36 @@
+"""VT010 negative corpus — the sanctioned ways to carry wide products:
+int64 widening, mass-conserved indicator sums, explicit low-bit masking,
+a machine-checked headroom bless, and one justified suppression."""
+
+import jax.numpy as jnp
+
+
+def _flat_code_wide(node, slot, vic_job):
+    # widened BEFORE the product: int64 holds NODES_PAD * V_WIDTH fine
+    v_width = vic_job.shape[1]
+    code = node.astype(jnp.int64) * v_width + slot
+    return code
+
+
+def _indicator_mass(node_cnt):
+    # per-node counts are mass-conserved (each task counted once): the
+    # running sum is bounded by TASKS, not NODES_PAD * TASKS
+    return jnp.cumsum(node_cnt)
+
+
+def _masked_lanes(node_maxt):
+    # the low-bit mask caps every element at 2**15-1 before the sum:
+    # NODES_PAD * 0x7FFF stays under 2**31
+    return jnp.cumsum(node_maxt & 0x7FFF)
+
+
+def _blessed_tight_cap(node, t_cap):
+    # the abstract cap on t_cap is TASKS, but cfg7 pins the per-step
+    # admission cap at 4096 — prove the real bound instead of widening
+    rows = node * t_cap  # vclint: headroom(NODES_PAD * 4096)
+    return rows
+
+
+def _suppressed_overflow(node_maxt):
+    cs = jnp.cumsum(node_maxt)  # vclint: disable=VT010 - host-only debug path: replayed on numpy int64, never traced
+    return cs

@@ -1,0 +1,20 @@
+"""VT010 positive corpus — int32 ranges that exceed 2**31-1 at the cfg7
+bucket extents (100k tasks x 50k nodes, mesh-padded): the pre-PR-16
+flat op-log encoding and an unbounded per-node-cap running sum."""
+
+import jax.numpy as jnp
+
+
+def _log_append_flat(log, node, slot, vic_job):
+    # the pre-PR-16 evict op-log encoding: node * V_WIDTH + slot spans
+    # ~6.6e9 at NODES_PAD x V_WIDTH extents — silently wraps in int32
+    v_width = vic_job.shape[1]
+    code = node * v_width + slot  # vclint-expect: VT010
+    return log.at[0, 1].set(code)
+
+
+def _quadratic_caps(node_maxt):
+    # per-node caps carry no mass bound (unlike per-node counts): the
+    # running sum genuinely reaches NODES_PAD * TASKS at the extremes
+    cs = jnp.cumsum(node_maxt)  # vclint-expect: VT010
+    return cs

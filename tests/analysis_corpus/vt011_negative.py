@@ -1,0 +1,25 @@
+"""VT011 negative corpus — the sanctioned guards: conjunction with the
+node-validity mask, an explicit real_n window, and one justified
+suppression proving the disable comment is load-bearing."""
+
+import jax.numpy as jnp
+
+
+def _window_masked(elig, real, rr):
+    # masking with the validity guard sanitizes the pad rows BEFORE the
+    # cross-row count — the post-PR-16 _sample_window shape
+    rolled = jnp.roll(elig & real, -rr)
+    cs = jnp.cumsum(rolled.astype(jnp.int32))
+    return cs
+
+
+def _window_real_n(used, real_n):
+    # the scalar-guard spelling: lanes past real_n are forced to the
+    # neutral fill before the reduce
+    n = used.shape[0]
+    lanes = jnp.where(jnp.arange(n) < real_n, used, 0.0)
+    return jnp.sum(lanes)
+
+
+def _raw_probe(used, real):
+    return jnp.sum(used)  # vclint: disable=VT011 - debug histogram: the probe harness zero-fills pad rows at allocation

@@ -1,0 +1,20 @@
+"""VT011 positive corpus — pad-tainted rows reaching unmasked cross-row
+sinks: the pre-PR-10 window-count shape (roll + cumsum over the raw
+eligibility mask) and an argsort over pad-garbage node payloads."""
+
+import jax.numpy as jnp
+
+
+def _window_unmasked(elig, real, rr):
+    # the pre-PR-10 bug shape: rolling the RAW eligibility mask brings
+    # pad rows into the window before the count
+    rolled = jnp.roll(elig, -rr)
+    cs = jnp.cumsum(rolled.astype(jnp.int32))  # vclint-expect: VT011
+    return cs
+
+
+def _rank_unmasked(used, real):
+    # argsort over a node-axis payload: pad rows hold stale garbage and
+    # land anywhere in the permutation
+    order = jnp.argsort(used)  # vclint-expect: VT011
+    return order

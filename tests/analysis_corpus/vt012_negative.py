@@ -1,0 +1,28 @@
+"""VT012 negative corpus — aliases rebound from the dispatch result
+before any further read (the sanctioned carry-threading idiom extended
+to derived handles), plus a justified suppression for a path-correlated
+ghost alias the may-analysis cannot see is dead."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("spec",), donate_argnums=(1,))
+def stage(spec, carry):
+    return carry, carry
+
+
+def driver(spec, carry):
+    handle = carry["used"]
+    probe = handle.shape  # pre-dispatch reads are legal
+    packed, carry = stage(spec, carry)
+    handle = carry["used"]  # re-derived from the NEW carry
+    return packed, probe, handle.sum()
+
+
+def driver_suppressed(spec, carry, audit):
+    mirror = carry if audit else None
+    packed, carry = stage(spec, carry)
+    tail = mirror if audit else packed  # vclint: disable=VT012 - audit mode pins donation off upstream: mirror is only non-None when stage ran undonated
+    return packed, tail

@@ -1,0 +1,23 @@
+"""VT012 positive corpus — aliases of a donated buffer read after the
+dispatch: the alias outlives the donation even though the donated NAME
+itself is never touched again (that direct read is VT006's territory)."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("spec",), donate_argnums=(1,))
+def stage(spec, carry):
+    return carry, carry
+
+
+def driver(spec, carry, audit):
+    # both of these capture the SAME device buffers the donation below
+    # invalidates — rebinding 'carry' from the result does not help them
+    mirror = carry if audit else None
+    handle = carry["used"]
+    packed, carry = stage(spec, carry)
+    a = mirror["alloc"]  # vclint-expect: VT012
+    b = handle.sum()  # vclint-expect: VT012
+    return packed, carry, a, b

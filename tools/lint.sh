@@ -1,21 +1,28 @@
 #!/usr/bin/env bash
 # The lint gate — the ONE definition shared by tests/test_static_analysis.py
 # and any CI wrapper, so "what the gate checks" can never fork:
-#   1. vclint (python -m volcano_tpu.analysis): the VT001-VT009 invariant
+#   1. vclint (python -m volcano_tpu.analysis): the VT001-VT012 invariant
 #      rules over the whole package — zero unsuppressed findings AND zero
 #      suppression drift against tools/lint_baseline.json (a new justified
 #      suppression must be landed deliberately via --write-baseline);
 #      a machine-readable JSON report lands at $LINT_REPORT
-#      (default /tmp/vclint_report.json) for CI archival;
+#      (default /tmp/vclint_report.json) for CI archival, including
+#      lint_wall_ms (this run vs cold reference, cache mode);
 #   2. compileall: every module byte-compiles (import-free syntax gate).
 #
+# Warm runs are incremental: per-file findings are memoized by content
+# hash in $LINT_CACHE (default /tmp/vclint_cache.json), so a re-run after
+# editing one file only re-analyzes that file (plus the whole-program
+# rules). Delete the cache file or change any analysis/*.py to force cold.
+#
 # Usage: tools/lint.sh   (from anywhere; PYTHON overrides the interpreter,
-#                         LINT_REPORT overrides the report path)
+#                         LINT_REPORT / LINT_CACHE override the artifacts)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 PY="${PYTHON:-python3}"
 "$PY" -m volcano_tpu.analysis \
     --baseline tools/lint_baseline.json \
     --report "${LINT_REPORT:-/tmp/vclint_report.json}" \
+    --cache "${LINT_CACHE:-/tmp/vclint_cache.json}" \
     volcano_tpu
 "$PY" -m compileall -q volcano_tpu

@@ -28,3 +28,5 @@ from volcano_tpu.analysis.core import (  # noqa: F401
     render,
 )
 from volcano_tpu.analysis import rules  # noqa: F401  (populates the registry)
+from volcano_tpu.analysis import absint  # noqa: F401  (v3 abstract-
+# interpretation rules VT010-VT012 self-register on import)

@@ -14,17 +14,35 @@ v2 additions:
   model — per mutation site the effect chain that covers it (VT007),
   the inferred lock/field map and locked-region dispatch closures
   (VT008), the channel-vs-sealed diff (VT009).
+
+v3 additions:
+- ``--explain VT010|VT011|VT012``: the abstract-interpretation reports —
+  value-range derivation chains (VT010), pad-taint source->sink paths
+  (VT011), donation timelines (VT012);
+- ``--cache FILE``: incremental lint — per-file findings memoized by
+  content hash (rule-module signature invalidates everything; the
+  whole-program rules re-run whenever ANY file changed, file-local rules
+  only on the changed files). Warm runs re-analyze nothing;
+- the ``--report`` JSON gains ``lint_wall_ms`` (this run / cold
+  reference, cache mode, files analyzed vs reused).
 """
 
 from __future__ import annotations
 
 import argparse
 import ast
+import hashlib
 import json
 import os
 import sys
+import time
 
 from volcano_tpu.analysis import all_rules, analyze_paths, get_rule, render
+from volcano_tpu.analysis.core import Finding, analyze_source, iter_py_files
+
+# rules that consume the cross-file program model (analysis/model.py):
+# their findings are only reusable when the WHOLE tree is unchanged
+MODEL_RULE_IDS = ("VT007", "VT008", "VT009")
 
 
 def _rel(path: str) -> str:
@@ -85,7 +103,7 @@ def _write_baseline(findings, path: str) -> None:
         fh.write("\n")
 
 
-def _write_report(findings, path: str) -> None:
+def _write_report(findings, path: str, wall: dict) -> None:
     active = [f.to_dict() for f in findings if not f.suppressed]
     muted = [f.to_dict() for f in findings if f.suppressed]
     by_rule: dict = {}
@@ -94,8 +112,94 @@ def _write_report(findings, path: str) -> None:
         entry["suppressed" if f.suppressed else "active"] += 1
     with open(path, "w", encoding="utf-8") as fh:
         json.dump({"findings": active, "suppressed": muted,
-                   "counts": by_rule}, fh, indent=1, sort_keys=True)
+                   "counts": by_rule, "lint_wall_ms": wall},
+                  fh, indent=1, sort_keys=True)
         fh.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# incremental lint: per-file findings memoized by content hash
+# ---------------------------------------------------------------------------
+
+
+def _rules_signature() -> str:
+    """Content hash of the analysis package itself — editing any rule or
+    the framework invalidates the whole cache."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for name in sorted(os.listdir(root)):
+        if name.endswith(".py"):
+            h.update(name.encode("utf-8"))
+            with open(os.path.join(root, name), "rb") as fh:
+                h.update(fh.read())
+    return h.hexdigest()
+
+
+def _analyze_cached(paths, cache_path: str):
+    """(findings, cache_blob, stats). File-local findings are reused
+    whenever the file's content hash matches; the whole-program rules'
+    findings additionally require the TREE hash to match (they read the
+    cross-file model), else they re-run — still skipping the per-file
+    AST passes for every unchanged file."""
+    files = iter_py_files(paths)
+    srcs: dict = {}
+    hashes: dict = {}
+    for p in files:
+        with open(p, "r", encoding="utf-8") as fh:
+            srcs[p] = fh.read()
+        hashes[p] = hashlib.sha256(
+            srcs[p].encode("utf-8", "replace")).hexdigest()
+    tree_hash = hashlib.sha256("".join(
+        f"{p}:{hashes[p]}\n" for p in sorted(files)).encode()).hexdigest()
+    sig = _rules_signature()
+
+    cached_files: dict = {}
+    cached_tree = cold_ms = None
+    if os.path.exists(cache_path):
+        try:
+            with open(cache_path, "r", encoding="utf-8") as fh:
+                blob = json.load(fh)
+            if blob.get("sig") == sig:
+                cached_files = blob.get("files", {})
+                cached_tree = blob.get("tree")
+                cold_ms = blob.get("cold_ms")
+        except (ValueError, OSError):
+            pass
+
+    model_rules = [get_rule(r) for r in MODEL_RULE_IDS]
+    local_rules = [r for r in all_rules() if r.id not in MODEL_RULE_IDS]
+    tree_same = cached_tree == tree_hash
+    findings: list = []
+    out_files: dict = {}
+    analyzed = reused = 0
+    for p in files:
+        ent = cached_files.get(p)
+        hit = ent is not None and ent.get("hash") == hashes[p]
+        if hit and tree_same:
+            loc = [Finding(**d) for d in ent["local"]]
+            mod = [Finding(**d) for d in ent["model"]]
+            reused += 1
+        elif hit:
+            loc = [Finding(**d) for d in ent["local"]]
+            mod = analyze_source(srcs[p], p, model_rules,
+                                 include_meta=False)
+            reused += 1
+        else:
+            loc = analyze_source(srcs[p], p, local_rules)
+            mod = analyze_source(srcs[p], p, model_rules,
+                                 include_meta=False)
+            analyzed += 1
+        findings.extend(loc)
+        findings.extend(mod)
+        out_files[p] = {"hash": hashes[p],
+                        "local": [f.to_dict() for f in loc],
+                        "model": [f.to_dict() for f in mod]}
+    mode = "cold" if reused == 0 else ("warm" if analyzed == 0 else
+                                       "partial")
+    blob = {"sig": sig, "tree": tree_hash, "cold_ms": cold_ms,
+            "files": out_files}
+    return findings, blob, dict(mode=mode, files_analyzed=analyzed,
+                                files_reused=reused, cold_ms=cold_ms)
 
 
 def _explain(rule_id: str, paths) -> int:
@@ -191,7 +295,10 @@ def _explain(rule_id: str, paths) -> int:
                 print(f"{fi.path}:{node.lineno} {node.attr:20s} "
                       f"consumed-by={fi.name:15s} {state}")
         return 0
-    print(f"--explain supports VT007/VT008/VT009, not {rule_id}",
+    if rule_id in ("VT010", "VT011", "VT012"):
+        from volcano_tpu.analysis import absint
+        return absint.explain(rule_id, norm)
+    print(f"--explain supports VT007..VT012, not {rule_id}",
           file=sys.stderr)
     return 2
 
@@ -225,8 +332,14 @@ def main(argv=None) -> int:
                         help="regenerate the suppression baseline from "
                              "the current tree and exit")
     parser.add_argument("--explain", default=None, metavar="VT007",
-                        help="print the inferred whole-program model for "
-                             "VT007/VT008/VT009 and exit")
+                        help="print the inferred whole-program model "
+                             "(VT007-VT009) or abstract-interpretation "
+                             "report (VT010-VT012) and exit")
+    parser.add_argument("--cache", default=None, metavar="FILE",
+                        help="incremental lint: memoize per-file findings "
+                             "by content hash; warm runs only re-analyze "
+                             "changed files (ignored with --select / "
+                             "--no-default-filter)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -250,8 +363,25 @@ def main(argv=None) -> int:
     if not paths:
         paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
-    findings = analyze_paths(paths, rules,
-                             respect_filters=not args.no_default_filter)
+    t0 = time.perf_counter()
+    cache_ok = args.cache and rules is None and not args.no_default_filter
+    if cache_ok:
+        findings, cache_blob, stats = _analyze_cached(paths, args.cache)
+    else:
+        findings = analyze_paths(paths, rules,
+                                 respect_filters=not args.no_default_filter)
+        cache_blob, stats = None, dict(
+            mode="off", files_analyzed=len(iter_py_files(paths)),
+            files_reused=0, cold_ms=None)
+    run_ms = round((time.perf_counter() - t0) * 1000.0, 1)
+    if cache_blob is not None:
+        if stats["mode"] == "cold" or cache_blob["cold_ms"] is None:
+            cache_blob["cold_ms"] = stats["cold_ms"] = run_ms
+        with open(args.cache, "w", encoding="utf-8") as fh:
+            json.dump(cache_blob, fh)
+    wall = {"run": run_ms, "cold": stats["cold_ms"], "mode": stats["mode"],
+            "files_analyzed": stats["files_analyzed"],
+            "files_reused": stats["files_reused"]}
 
     if args.write_baseline:
         _write_baseline(findings, args.write_baseline)
@@ -259,7 +389,7 @@ def main(argv=None) -> int:
               f"({sum(_baseline_counts(findings).values())} suppression(s))")
         return 0
     if args.report:
-        _write_report(findings, args.report)
+        _write_report(findings, args.report, wall)
 
     baseline_problems = []
     if args.baseline:

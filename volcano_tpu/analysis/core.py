@@ -131,16 +131,25 @@ def analyze_source(
     path: str,
     rules: Optional[Sequence[Rule]] = None,
     respect_filters: bool = True,
+    include_meta: bool = True,
 ) -> List[Finding]:
     """Run ``rules`` over one source blob. Returns ALL findings with
     ``suppressed`` marked; callers filter on it. A syntax error is reported
     as a VT999 finding rather than an exception so one broken file cannot
-    mask the rest of a tree scan."""
+    mask the rest of a tree scan.
+
+    ``include_meta=False`` drops the per-file meta findings (VT000 bare
+    suppressions, VT999 syntax errors) — for callers that split one file's
+    rule set across several passes (the incremental lint cache re-runs
+    only the whole-program rules on unchanged files) and must not emit
+    the meta findings twice."""
     if rules is None:
         rules = all_rules()
     try:
         tree = ast.parse(src)
     except SyntaxError as e:
+        if not include_meta:
+            return []
         return [Finding("VT999", path, e.lineno or 0, e.offset or 0,
                         f"syntax error: {e.msg}")]
 
@@ -152,13 +161,14 @@ def analyze_source(
 
     sups = parse_suppressions(src)
     # VT000 meta-rule: a suppression without a justification is a finding.
-    for s in sups:
-        if not s.justification:
-            findings.append(Finding(
-                "VT000", path, s.line, 0,
-                "suppression without justification — write "
-                "'# vclint: disable=%s - <why this is safe>'"
-                % ",".join(s.rules)))
+    if include_meta:
+        for s in sups:
+            if not s.justification:
+                findings.append(Finding(
+                    "VT000", path, s.line, 0,
+                    "suppression without justification — write "
+                    "'# vclint: disable=%s - <why this is safe>'"
+                    % ",".join(s.rules)))
 
     file_disabled = set()
     line_disabled: Dict[int, set] = {}

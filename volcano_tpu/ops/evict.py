@@ -72,7 +72,10 @@ from volcano_tpu.scheduler.plugins.drf import SHARE_DELTA
 logger = logging.getLogger(__name__)
 
 # op log kinds (packed int32 rows [kind, a, b])
-OP_EVICT = 0      # a = node * V + slot
+# OP_EVICT carries (node, slot) as separate columns: the flat
+# node * V + slot encoding overflows int32 once NODES_PAD * V_WIDTH
+# crosses 2^31 (cfg7 x victim-bucket extents reach ~6.6e9)
+OP_EVICT = 0      # a = node, b = slot
 OP_PIPELINE = 1   # a = preemptor task index, b = node
 OP_COMMIT = 2     # statement commit marker (preempt only)
 
@@ -447,9 +450,7 @@ def _apply_evict_slot(enc, st, node, slot, active):
     st["ready"] = st["ready"].at[jv].add(-ai)
     st["job_alloc"] = st["job_alloc"].at[jv].add(-dreq)
     st["queue_alloc"] = st["queue_alloc"].at[qv].add(-dreq)
-    v_width = enc["vic_job"].shape[1]
-    return _log_append(st, OP_EVICT, node * v_width + slot, jnp.int32(0),
-                       active)
+    return _log_append(st, OP_EVICT, node, slot, active)
 
 
 def _apply_pipeline(enc, st, t, node):
@@ -488,8 +489,8 @@ def _discard(enc, st, stmt_start):
         is_e = kind == OP_EVICT
         is_p = kind == OP_PIPELINE
         # evict inverse (un-evict: alive back, ready/job/queue re-add)
-        node_e = jnp.clip(a // v_width, 0, n - 1)
-        slot = jnp.clip(a % v_width, 0, v_width - 1)
+        node_e = jnp.clip(a, 0, n - 1)
+        slot = jnp.clip(b, 0, v_width - 1)
         jv = enc["vic_job"][node_e, slot]
         qv = enc["vic_queue"][node_e, slot]
         vreq = jnp.where(is_e, enc["vic_req"][node_e, slot], 0.0)
@@ -1802,14 +1803,13 @@ class _EvictPlan:
         from volcano_tpu.scheduler.util import scheduler_helper as helper
 
         ssn = self.ssn
-        v = self.v
         if (kind or self.kind) == "preempt":
             stmt = None
             for kind_, a, b in log.tolist():
                 if kind_ == OP_EVICT:
                     if stmt is None:
                         stmt = ssn.statement()
-                    task = self.vic_rows[a // v][a % v]
+                    task = self.vic_rows[a][b]
                     try:
                         stmt.evict(task.shared_clone(), "preempt")
                     except Exception as e:
@@ -1833,7 +1833,7 @@ class _EvictPlan:
         else:
             for kind_, a, b in log.tolist():
                 if kind_ == OP_EVICT:
-                    task = self.vic_rows[a // v][a % v]
+                    task = self.vic_rows[a][b]
                     try:
                         ssn.evict(task.shared_clone(), "reclaim")
                     except (KeyError, RuntimeError) as e:

@@ -102,7 +102,10 @@ def _sample_window(mask, node_real, real_n, rr, num_to_find):
 
     Returns (selected mask, processed real-node count, found any).
     """
-    rolled = jnp.roll(mask, -rr)
+    # mask pad slots explicitly before the cross-row cumsum: callers uphold
+    # "padded slots are never feasible", but the window count must not
+    # depend on that contract holding at every call site (VT011)
+    rolled = jnp.roll(mask & node_real, -rr)
     rolled_real = jnp.roll(node_real, -rr).astype(jnp.int32)
     c = jnp.cumsum(rolled.astype(jnp.int32))
     found_total = c[-1]

@@ -615,6 +615,10 @@ def _run_fused(ssn, chain, action_ms, prep, plan, bf, t_chain) -> None:
         # standing replica instead of discarding them (ops/replica.py
         # adoption: the next serve skips re-scattering rows only this
         # chain's own placements changed)
+        # adopt_carry is None on every path where _fuse_reclaim donated
+        # the carry (both sides test the same '"reclaim" in chain'), so
+        # this alias only outlives a preempt-terminal chain:
+        # vclint: disable=VT012 - adopt_carry proven None when the carry was donated
         _offer_carry(ssn, prep, plan, adopt_carry)
 
 
